@@ -1,0 +1,21 @@
+"""fluidframework_tpu — a TPU-native real-time collaboration framework.
+
+A ground-up re-design of the capabilities of Microsoft's Fluid Framework
+(reference: ghostshell202/FluidFramework) for TPU hardware:
+
+- Distributed data structures (SharedString, SharedMap, SharedMatrix, SharedTree)
+  whose edits are ops, sequenced by a central ordering service and merged
+  deterministically on every client.
+- The merge hot path (reference: ``packages/dds/merge-tree``,
+  ``packages/dds/tree``) is implemented as pure JAX kernels over
+  struct-of-arrays document state: position resolution by masked prefix sums
+  (replacing the B-tree + PartialSequenceLengths), op application as masked
+  gathers/scatters, ``lax.scan`` over the sequenced op stream, ``vmap`` across
+  documents and mesh-sharding (``jax.sharding``) across chips.
+- A host-side service layer reproduces the alfred/deli/scribe sequencing
+  pipeline (reference: ``server/routerlicious``).
+"""
+
+__version__ = "0.1.0"
+
+from fluidframework_tpu.protocol import constants, types  # noqa: F401

@@ -1,0 +1,131 @@
+"""Struct-of-arrays document state for the merge-sequence kernel.
+
+TPU-native replacement for the reference merge-tree's pointer-based B-tree
+(``packages/dds/merge-tree/src/mergeTreeNodes.ts``): one document is a dense
+int32 table of segment rows in document order (holes allowed, reclaimed by
+:func:`fluidframework_tpu.ops.merge_kernel.compact`). Every per-segment stamp
+of the reference — ``seq``, ``clientId``, ``localSeq``, ``removedSeq``,
+``removedClientIds``, ``localRemovedSeq`` (``mergeTreeNodes.ts:126-175``) —
+becomes an int32 lane, so op application is masked elementwise math + prefix
+sums instead of tree traversal, and ``vmap`` batches documents.
+
+Content addressing: segment text lives host-side, keyed by ``orig`` (an id the
+inserting client allocates) — a row covers ``payload[orig][off : off+length]``.
+Splits are pure array ops (adjust ``off``/``length``); the device never sees
+text bytes, only structure.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from fluidframework_tpu.protocol.constants import KIND_FREE, RSEQ_NONE
+
+
+class SegmentState(NamedTuple):
+    """One document's merge state (or a [D, ...] batch when stacked/vmapped).
+
+    Array lanes have shape ``[S]`` (segment capacity); scalars are 0-d int32.
+    """
+
+    # --- per-segment lanes [S] ---
+    kind: jnp.ndarray  # KIND_FREE / KIND_TEXT / KIND_MARKER
+    orig: jnp.ndarray  # host content id
+    off: jnp.ndarray  # offset into the orig payload
+    length: jnp.ndarray  # segment length (chars)
+    seq: jnp.ndarray  # insert seq (UNASSIGNED_SEQ while local)
+    client: jnp.ndarray  # inserting client slot
+    lseq: jnp.ndarray  # local seq of pending insert (0 = none)
+    rseq: jnp.ndarray  # removedSeq (RSEQ_NONE = not removed, UNASSIGNED_SEQ = local)
+    rlseq: jnp.ndarray  # local seq of pending remove (0 = none)
+    rbits: jnp.ndarray  # bitmask of removing client slots (removedClientIds)
+    aseq: jnp.ndarray  # seq of last annotate (0 = never)
+    alseq: jnp.ndarray  # local seq of pending annotate (0 = none)
+    aval: jnp.ndarray  # interned annotate value
+    # --- per-document scalars ---
+    count: jnp.ndarray  # high-water mark of used rows
+    min_seq: jnp.ndarray  # collab-window minimum sequence number
+    cur_seq: jnp.ndarray  # last applied sequence number
+    self_client: jnp.ndarray  # local client slot (NO_CLIENT on the server)
+    err: jnp.ndarray  # ERR_* flag bits (sticky)
+
+
+SEGMENT_LANES = (
+    "kind",
+    "orig",
+    "off",
+    "length",
+    "seq",
+    "client",
+    "lseq",
+    "rseq",
+    "rlseq",
+    "rbits",
+    "aseq",
+    "alseq",
+    "aval",
+)
+
+
+def make_state(capacity: int, self_client: int, min_seq: int = 0) -> SegmentState:
+    """Fresh empty document state with room for ``capacity`` segment rows."""
+    def z():
+        # Distinct buffers per lane: donation rejects aliased arguments.
+        return jnp.zeros((capacity,), jnp.int32)
+
+    return SegmentState(
+        kind=jnp.full((capacity,), KIND_FREE, jnp.int32),
+        orig=z(),
+        off=z(),
+        length=z(),
+        seq=z(),
+        client=z(),
+        lseq=z(),
+        rseq=jnp.full((capacity,), RSEQ_NONE, jnp.int32),
+        rlseq=z(),
+        rbits=z(),
+        aseq=z(),
+        alseq=z(),
+        aval=z(),
+        count=jnp.int32(0),
+        min_seq=jnp.int32(min_seq),
+        cur_seq=jnp.int32(0),
+        self_client=jnp.int32(self_client),
+        err=jnp.int32(0),
+    )
+
+
+def make_batched_state(n_docs: int, capacity: int, self_client: int) -> SegmentState:
+    """[D, S] batch of empty documents (the vmap/pjit operand)."""
+    one = make_state(capacity, self_client)
+    return SegmentState(*[jnp.broadcast_to(x, (n_docs,) + x.shape).copy() for x in one])
+
+
+def capacity_of(state: SegmentState) -> int:
+    return state.kind.shape[-1]
+
+
+def to_host(state: SegmentState) -> "SegmentState":
+    """Pull a (single-doc) state to host numpy for materialization/tests."""
+    return SegmentState(*[np.asarray(x) for x in state])
+
+
+def materialize(state: SegmentState, payloads: dict) -> str:
+    """Join live, locally-visible rows into the document text.
+
+    Local perspective (reference ``localNetLength`` mergeTree.ts:613): any
+    removal — acked or pending — hides the segment.
+    """
+    h = to_host(state)
+    parts = []
+    for i in range(int(h.count)):
+        if int(h.kind[i]) == KIND_FREE:
+            continue
+        if int(h.rseq[i]) != RSEQ_NONE:
+            continue
+        o, f, n = int(h.orig[i]), int(h.off[i]), int(h.length[i])
+        parts.append(payloads[o][f : f + n])
+    return "".join(parts)

@@ -1,0 +1,1 @@
+from fluidframework_tpu.protocol import constants, types  # noqa: F401

@@ -1,0 +1,22 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX init.
+
+Mirrors the reference's strategy of running the full pipeline in-process
+(LocalDeltaConnectionServer); multi-chip sharding is validated on virtual CPU
+devices, real-TPU perf only via bench.py.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The environment may pre-register a TPU backend at interpreter startup
+# (sitecustomize), in which case the env var alone is too late — force the
+# platform through the config system as well.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,206 @@
+"""Multi-replica convergence fuzz ("farm" tests).
+
+The TPU analog of the reference's merge-tree farm suites
+(``client.conflictFarm.spec.ts``): N clients generate random local ops
+against their own replica state (kernel + oracle), a FIFO sequencer assigns
+the total order, every replica (clients + a server replica) applies the
+sequenced stream — including local-echo acks — and all replicas must end
+bit-identical. This is the race-detector equivalent for merge logic
+(SURVEY.md §5.2: determinism checking).
+"""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.ops import encode as E
+from fluidframework_tpu.ops.merge_kernel import compact, jit_apply_ops
+from fluidframework_tpu.ops.segment_state import (
+    make_state,
+    materialize,
+    to_host,
+)
+from fluidframework_tpu.protocol.constants import (
+    F_CLIENT,
+    F_LSEQ,
+    F_SEQ,
+    F_TYPE,
+    KIND_FREE,
+    NO_CLIENT,
+    OP_ANNOTATE,
+    OP_INSERT,
+    OP_REMOVE,
+    RSEQ_NONE,
+    UNASSIGNED_SEQ,
+)
+from fluidframework_tpu.testing.oracle import OracleDoc
+
+CAP = 512
+ALPHABET = "abcdefghijklmnopqrstuvwxyz"
+OP_KIND = {OP_INSERT: "insert", OP_REMOVE: "remove", OP_ANNOTATE: "annotate"}
+
+
+class Replica:
+    """One client: kernel state + oracle mirror + inbox + pending queue."""
+
+    def __init__(self, client: int):
+        self.client = client
+        self.state = make_state(CAP, client)
+        self.oracle = OracleDoc(client)
+        self.inbox = []
+        self.ref_seq = 0
+        self.lseq = 0
+
+    def _apply(self, row: np.ndarray):
+        self.state = jit_apply_ops(self.state, row[None, :].astype(np.int32))
+        self.oracle.apply(row)
+
+    def submit(self, row: np.ndarray) -> tuple:
+        """Apply a local (unacked) op and return the submission record."""
+        self.lseq += 1
+        row = row.copy()
+        row[F_LSEQ] = self.lseq
+        self._apply(row)
+        return (self.client, row)
+
+    def deliver(self, seq: int, sender: int, row: np.ndarray):
+        if sender == self.client:
+            kind = OP_KIND[int(row[F_TYPE])]
+            self._apply(E.ack(kind, int(row[F_LSEQ]), seq))
+        else:
+            srow = row.copy()
+            srow[F_SEQ] = seq
+            srow[F_LSEQ] = 0
+            self._apply(srow)
+        self.ref_seq = seq
+
+    def text(self, payloads):
+        return materialize(self.state, payloads)
+
+
+def visible_struct(state):
+    """Structural fingerprint of the *visible* document.
+
+    Tombstone relative order may legitimately differ between replicas (the
+    reference has the same property: a local insert tie-breaks in front of an
+    acked tombstone that remote replicas skip entirely), so convergence is
+    asserted on visible rows only.
+    """
+    h = to_host(state)
+    rows = []
+    for i in range(int(h.count)):
+        if int(h.kind[i]) == KIND_FREE or int(h.rseq[i]) != RSEQ_NONE:
+            continue
+        rows.append(
+            (
+                int(h.orig[i]),
+                int(h.off[i]),
+                int(h.length[i]),
+                int(h.seq[i]),
+                int(h.client[i]),
+                int(h.aval[i]),
+            )
+        )
+    return rows
+
+
+def gen_local_op(rng, rep: Replica, payloads, next_orig):
+    length = len(rep.oracle.text(payloads))
+    choice = rng.integers(0, 3) if length > 0 else 0
+    if choice == 0:
+        n = int(rng.integers(1, 5))
+        payloads[next_orig[0]] = "".join(rng.choice(list(ALPHABET), n))
+        row = E.insert(
+            int(rng.integers(0, length + 1)),
+            next_orig[0],
+            n,
+            seq=UNASSIGNED_SEQ,
+            ref=rep.ref_seq,
+            client=rep.client,
+        )
+        next_orig[0] += 1
+    elif choice == 1:
+        a = int(rng.integers(0, length))
+        b = int(rng.integers(a + 1, min(length, a + 8) + 1))
+        row = E.remove(a, b, seq=UNASSIGNED_SEQ, ref=rep.ref_seq, client=rep.client)
+    else:
+        a = int(rng.integers(0, length))
+        b = int(rng.integers(a + 1, min(length, a + 8) + 1))
+        row = E.annotate(
+            a, b, int(rng.integers(1, 50)), seq=UNASSIGNED_SEQ,
+            ref=rep.ref_seq, client=rep.client,
+        )
+    return row
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_farm_convergence(seed):
+    rng = np.random.default_rng(seed)
+    n_clients = 3 + seed % 3
+    n_ops = 30
+    reps = [Replica(c) for c in range(n_clients)]
+    server_k = make_state(CAP, NO_CLIENT)
+    server_o = OracleDoc(NO_CLIENT)
+    payloads = {}
+    next_orig = [1]
+
+    raw_queue = []  # FIFO into the "sequencer"
+    seq = 0
+    sequenced = []  # (seq, sender, row)
+    submitted = 0
+
+    def sequence_some(k):
+        nonlocal seq, server_k, raw_queue
+        for _ in range(min(k, len(raw_queue))):
+            sender, row = raw_queue.pop(0)
+            seq += 1
+            srow = row.copy()
+            srow[F_SEQ] = seq
+            srow[F_LSEQ] = 0
+            server_k = jit_apply_ops(server_k, srow[None, :].astype(np.int32))
+            server_o.apply(srow)
+            sequenced.append((seq, sender, row))
+
+    while submitted < n_ops * n_clients:
+        act = rng.integers(0, 3)
+        c = int(rng.integers(0, n_clients))
+        rep = reps[c]
+        if act == 0:
+            raw_queue.append(rep.submit(gen_local_op(rng, rep, payloads, next_orig)))
+            submitted += 1
+        elif act == 1:
+            sequence_some(int(rng.integers(1, 4)))
+        else:
+            # Deliver some sequenced ops to a random client, in order.
+            delivered = [s for s, _, _ in sequenced if s <= rep.ref_seq]
+            pending = sequenced[len(delivered):]
+            for s, sender, row in pending[: int(rng.integers(1, 5))]:
+                rep.deliver(s, sender, row)
+
+    # Drain: sequence and deliver everything.
+    sequence_some(len(raw_queue))
+    for rep in reps:
+        for s, sender, row in sequenced:
+            if s > rep.ref_seq:
+                rep.deliver(s, sender, row)
+
+    texts = [rep.text(payloads) for rep in reps]
+    server_text = materialize(server_k, payloads)
+    assert all(t == texts[0] for t in texts), f"client texts diverged: {texts}"
+    assert server_text == texts[0]
+    assert server_o.text(payloads) == texts[0]
+
+    structs = [visible_struct(rep.state) for rep in reps]
+    structs.append(visible_struct(server_k))
+    assert all(s == structs[0] for s in structs), "replica structures diverged"
+
+    for rep in reps:
+        assert int(to_host(rep.state).err) == 0
+
+    # Advance the collab window to the final seq and compact every replica:
+    # text must be stable and still convergent.
+    fin = np.stack([E.noop(msn=seq, seq=seq)]).astype(np.int32)
+    compacted = []
+    for rep in reps:
+        st = compact(jit_apply_ops(rep.state, fin))
+        compacted.append(materialize(st, payloads))
+    assert all(t == texts[0] for t in compacted)
