@@ -1,0 +1,209 @@
+"""SharedString — collaborative text DDS backed by the merge kernel.
+
+Reference: ``packages/dds/sequence/src/sharedString.ts`` +
+``packages/dds/merge-tree/src/client.ts`` (``applyMsg`` :858, local-op
+``insertSegmentLocal``, ack :641). The TPU design splits responsibilities:
+merge structure lives device-side in a :class:`SegmentState` table; segment
+payload text lives host-side keyed by an ``orig`` content id (allocated per
+local op as ``client_slot * 2^20 + lseq``), so device rows never carry bytes.
+
+Ops lower to int32 kernel rows (``ops.encode``); the local echo applies
+immediately with the UNASSIGNED seq sentinel, acks stamp server seqs by
+``lseq``, remote ops apply at their ``(refSeq, client)`` perspective —
+exactly the reference's applyMsg trichotomy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from fluidframework_tpu.ops import encode as E
+from fluidframework_tpu.ops.merge_kernel import compact, jit_apply_ops
+from fluidframework_tpu.ops.segment_state import (
+    capacity_of,
+    grow,
+    make_state,
+    materialize,
+    to_host,
+)
+from fluidframework_tpu.protocol.constants import (
+    ERR_CAPACITY,
+    KIND_FREE,
+    RSEQ_NONE,
+    UNASSIGNED_SEQ,
+)
+from fluidframework_tpu.protocol.types import SequencedDocumentMessage
+from fluidframework_tpu.runtime.shared_object import SharedObject
+
+_ORIG_STRIDE = 1 << 20  # content ids: client_slot * stride + lseq
+
+
+class SharedString(SharedObject):
+    """Collaborative sequence of text with LWW annotations (single lane)."""
+
+    def __init__(self, channel_id: str, capacity: int = 256):
+        super().__init__(channel_id)
+        self._capacity = capacity
+        self._state = None  # created on attach (needs client slot)
+        self._payloads: dict = {}
+        self._lseq = 0
+
+    def attach(self, runtime) -> None:
+        super().attach(runtime)
+        self._state = make_state(self._capacity, self.client_id)
+
+    # -- reads ----------------------------------------------------------------
+
+    def get_text(self) -> str:
+        return materialize(self._state, self._payloads)
+
+    def __len__(self) -> int:
+        return len(self.get_text())
+
+    def annotations(self) -> list:
+        """[(start, end, value)] runs of the annotation lane over live text."""
+        h = to_host(self._state)
+        runs = []
+        pos = 0
+        for i in range(int(h.count)):
+            if int(h.kind[i]) == KIND_FREE or int(h.rseq[i]) != RSEQ_NONE:
+                continue
+            n, v = int(h.length[i]), int(h.aval[i])
+            if v != 0:
+                if runs and runs[-1][1] == pos and runs[-1][2] == v:
+                    runs[-1] = (runs[-1][0], pos + n, v)
+                else:
+                    runs.append((pos, pos + n, v))
+            pos += n
+        return runs
+
+    @property
+    def err_flags(self) -> int:
+        return int(to_host(self._state).err)
+
+    # -- local edits ----------------------------------------------------------
+
+    def insert_text(self, pos: int, text: str) -> None:
+        assert len(text) > 0, "empty insert"
+        self._lseq += 1
+        orig = self.client_id * _ORIG_STRIDE + self._lseq
+        self._payloads[orig] = text
+        row = E.insert(
+            pos, orig, len(text), seq=UNASSIGNED_SEQ,
+            client=self.client_id, lseq=self._lseq,
+        )
+        self._apply(row)
+        self.submit_local_message(
+            {"k": "ins", "pos": pos, "text": text, "orig": orig},
+            {"kind": "insert", "lseq": self._lseq},
+        )
+
+    def remove_range(self, start: int, end: int) -> None:
+        self._lseq += 1
+        row = E.remove(
+            start, end, seq=UNASSIGNED_SEQ, client=self.client_id, lseq=self._lseq
+        )
+        self._apply(row)
+        self.submit_local_message(
+            {"k": "rem", "start": start, "end": end},
+            {"kind": "remove", "lseq": self._lseq},
+        )
+
+    def annotate(self, start: int, end: int, value: int) -> None:
+        """Annotate a range with an interned int value (LWW single lane;
+        PropertySet-keyed annotation is layered host-side in round 2)."""
+        self._lseq += 1
+        row = E.annotate(
+            start, end, value, seq=UNASSIGNED_SEQ,
+            client=self.client_id, lseq=self._lseq,
+        )
+        self._apply(row)
+        self.submit_local_message(
+            {"k": "ann", "start": start, "end": end, "val": value},
+            {"kind": "annotate", "lseq": self._lseq},
+        )
+
+    # -- sequenced stream -----------------------------------------------------
+
+    def process_core(
+        self,
+        msg: SequencedDocumentMessage,
+        local: bool,
+        local_metadata: Optional[Any],
+    ) -> None:
+        if local:
+            row = E.ack(
+                local_metadata["kind"],
+                local_metadata["lseq"],
+                msg.sequence_number,
+                msn=msg.minimum_sequence_number,
+            )
+        else:
+            row = self._row_from_contents(msg)
+        self._apply(row)
+
+    def _row_from_contents(self, msg: SequencedDocumentMessage) -> np.ndarray:
+        c = msg.contents
+        common = dict(
+            seq=msg.sequence_number,
+            ref=msg.reference_sequence_number,
+            client=msg.client_id,
+            msn=msg.minimum_sequence_number,
+        )
+        if c["k"] == "ins":
+            self._payloads[c["orig"]] = c["text"]
+            return E.insert(c["pos"], c["orig"], len(c["text"]), **common)
+        if c["k"] == "rem":
+            return E.remove(c["start"], c["end"], **common)
+        if c["k"] == "ann":
+            return E.annotate(c["start"], c["end"], c["val"], **common)
+        raise ValueError(f"unknown SharedString op {c!r}")
+
+    def _apply(self, row: np.ndarray) -> None:
+        self._state = jit_apply_ops(self._state, row[None, :].astype(np.int32))
+        # Keep headroom: compact when the table is nearly full, growing if
+        # the live rows genuinely outgrew it. Compaction timing is
+        # replica-local and only touches invisible state, so replicas stay
+        # convergent regardless of when each one compacts.
+        cap = capacity_of(self._state)
+        if int(to_host(self._state).count) > cap - 8:
+            self._state = compact(self._state)
+            if int(to_host(self._state).count) > cap - 8:
+                self._state = grow(self._state, cap * 2)
+
+    # -- summary / load (round-1: full state snapshot) ------------------------
+
+    def summarize_core(self) -> dict:
+        h = to_host(self._state)
+        n = int(h.count)
+        return {
+            "lanes": {k: np.asarray(getattr(h, k))[:n].tolist() for k in (
+                "kind", "orig", "off", "length", "seq", "client", "lseq",
+                "rseq", "rlseq", "rbits", "aseq", "alseq", "aval",
+            )},
+            "count": n,
+            "min_seq": int(h.min_seq),
+            "cur_seq": int(h.cur_seq),
+            "payloads": dict(self._payloads),
+        }
+
+    def load_core(self, summary: dict) -> None:
+        st = make_state(max(self._capacity, summary["count"] + 16), self.client_id)
+        h = to_host(st)
+        import jax.numpy as jnp
+
+        n = summary["count"]
+        updates = {}
+        for k, vals in summary["lanes"].items():
+            lane = np.asarray(getattr(h, k)).copy()
+            lane[:n] = vals
+            updates[k] = jnp.asarray(lane)
+        self._state = st._replace(
+            **updates,
+            count=jnp.int32(n),
+            min_seq=jnp.int32(summary["min_seq"]),
+            cur_seq=jnp.int32(summary["cur_seq"]),
+        )
+        self._payloads = {int(k): v for k, v in summary["payloads"].items()}

@@ -108,6 +108,25 @@ def capacity_of(state: SegmentState) -> int:
     return state.kind.shape[-1]
 
 
+def grow(state: SegmentState, new_capacity: int) -> SegmentState:
+    """Reallocate a (single-doc) state with a larger segment table."""
+    cap = capacity_of(state)
+    assert new_capacity > cap, "grow() requires a larger capacity"
+    pad = new_capacity - cap
+    fills = {"kind": KIND_FREE, "rseq": RSEQ_NONE}
+    return state._replace(
+        **{
+            k: jnp.concatenate(
+                [
+                    getattr(state, k),
+                    jnp.full((pad,), fills.get(k, 0), jnp.int32),
+                ]
+            )
+            for k in SEGMENT_LANES
+        }
+    )
+
+
 def to_host(state: SegmentState) -> "SegmentState":
     """Pull a (single-doc) state to host numpy for materialization/tests."""
     return SegmentState(*[np.asarray(x) for x in state])

@@ -1,0 +1,155 @@
+"""Container runtime — op routing, outbox batching, pending (unacked) state.
+
+Reference: ``packages/runtime/container-runtime`` (``process``
+containerRuntime.ts:1843, ``submit`` :2817 → ``Outbox``
+opLifecycle/outbox.ts:34, ``PendingStateManager`` pendingStateManager.ts:81)
+collapsed with the datastore layer (``packages/runtime/datastore``) into one
+host-side runtime: channels (DDS instances) register by id, local ops batch
+per explicit ``flush()`` (the JS-turn boundary analog), inbound sequenced
+ops route to channels, and the local client's own ops are matched FIFO
+against pending state to drive the ack path.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Dict, Optional
+
+from fluidframework_tpu.protocol.types import (
+    DocumentMessage,
+    MessageType,
+    SequencedDocumentMessage,
+)
+from fluidframework_tpu.runtime.shared_object import SharedObject
+from fluidframework_tpu.service.local_server import LocalFluidService
+
+
+class ContainerRuntime:
+    """One client's runtime for one document."""
+
+    def __init__(
+        self,
+        service: LocalFluidService,
+        doc_id: str,
+        channels: tuple = (),
+        mode: str = "write",
+    ):
+        """Connect and catch up to head before becoming interactive
+        (reference Container.load, container.ts:300: snapshot + delta replay
+        precede any local edit — editing from behind the MSN gets nacked).
+
+        ``channels`` are the DDS instances this container hosts; they must
+        exist before catch-up so historical channel ops have a target.
+        """
+        self.doc_id = doc_id
+        self.connection = service.connect(doc_id, mode)
+        self.client_id = self.connection.client_id
+        self.channels: Dict[str, SharedObject] = {}
+        self.ref_seq = 0  # last processed sequence number
+        self.min_seq = 0
+        self.client_seq = 0  # outbound clientSequenceNumber
+        # FIFO of (client_seq, channel_id, contents, local_metadata):
+        # reference PendingStateManager semantics.
+        self.pending: deque = deque()
+        self._outbox: list = []
+        self.quorum_members: Dict[int, dict] = {}
+        self.on_op: Optional[Callable[[SequencedDocumentMessage], None]] = None
+        for ch in channels:
+            self.create_channel(ch)
+        self.process_incoming()  # catch up to head
+
+    # -- channels -------------------------------------------------------------
+
+    def create_channel(self, channel: SharedObject) -> SharedObject:
+        assert channel.id not in self.channels, f"duplicate channel {channel.id}"
+        channel.attach(self)
+        self.channels[channel.id] = channel
+        return channel
+
+    def get_channel(self, channel_id: str) -> SharedObject:
+        return self.channels[channel_id]
+
+    # -- outbound (submit -> outbox -> flush, D.1) ----------------------------
+
+    def submit_channel_op(
+        self, channel_id: str, contents: Any, local_metadata: Any = None
+    ) -> None:
+        self._outbox.append((channel_id, contents, local_metadata))
+
+    def flush(self) -> None:
+        """Send the accumulated batch (the JS-turn-end flush)."""
+        batch, self._outbox = self._outbox, []
+        n = len(batch)
+        for i, (channel_id, contents, local_metadata) in enumerate(batch):
+            self.client_seq += 1
+            self.pending.append((self.client_seq, channel_id, contents, local_metadata))
+            self.connection.submit(
+                DocumentMessage(
+                    client_sequence_number=self.client_seq,
+                    reference_sequence_number=self.ref_seq,
+                    type=MessageType.OPERATION,
+                    contents={"address": channel_id, "contents": contents},
+                    metadata={"batch": n > 1, "batchIndex": i, "batchCount": n},
+                )
+            )
+
+    # -- inbound (process, §3.2) ----------------------------------------------
+
+    def process_incoming(self, n: Optional[int] = None) -> int:
+        """Drain up to n inbound sequenced messages through the runtime.
+
+        Flushes the outbox first: an op's position semantics bind to the
+        refSeq it was created at, so no inbound op may interleave between
+        creation and submission (the reference guarantees this by flushing
+        at JS-turn end before the inbound DeltaQueue resumes).
+        """
+        self.flush()
+        msgs = self.connection.take_inbox(n)
+        for msg in msgs:
+            self._process_one(msg)
+        return len(msgs)
+
+    def _process_one(self, msg: SequencedDocumentMessage) -> None:
+        assert (
+            msg.sequence_number == self.ref_seq + 1
+        ), f"sequence gap: {self.ref_seq} -> {msg.sequence_number}"
+        self.ref_seq = msg.sequence_number
+        self.min_seq = max(self.min_seq, msg.minimum_sequence_number)
+
+        if msg.type == MessageType.CLIENT_JOIN:
+            self.quorum_members[msg.contents] = {"client_id": msg.contents}
+        elif msg.type == MessageType.CLIENT_LEAVE:
+            self.quorum_members.pop(msg.contents, None)
+        elif msg.type == MessageType.OPERATION:
+            address = msg.contents["address"]
+            inner = msg.contents["contents"]
+            local = msg.client_id == self.client_id
+            local_metadata = None
+            if local:
+                assert self.pending, "ack with no pending op"
+                pseq, pchan, pcontents, local_metadata = self.pending.popleft()
+                assert pseq == msg.client_sequence_number, (
+                    f"pending mismatch: {pseq} != {msg.client_sequence_number}"
+                )
+                assert pchan == address
+            channel = self.channels.get(address)
+            if channel is not None:
+                channel.process_core(
+                    msg.__class__(
+                        **{**msg.__dict__, "contents": inner}
+                    ),
+                    local,
+                    local_metadata,
+                )
+        if self.on_op is not None:
+            self.on_op(msg)
+
+    # -- summaries (round-1 minimal: full snapshot, no incremental handles) ---
+
+    def summarize(self) -> dict:
+        return {
+            "sequence_number": self.ref_seq,
+            "channels": {
+                cid: ch.summarize_core() for cid, ch in self.channels.items()
+            },
+        }

@@ -1,0 +1,64 @@
+"""SharedObject base — the DDS contract.
+
+Reference: ``packages/dds/shared-object-base/src/sharedObject.ts`` (abstract
+hooks ``processCore``/``summarizeCore``/``loadCore``/``reSubmitCore`` at
+:308,332,341,534,722). A channel submits local messages through its runtime
+and processes the sequenced stream; subclasses implement the merge logic
+(for sequence-like DDSes, by lowering ops to kernel rows).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional
+
+from fluidframework_tpu.protocol.types import SequencedDocumentMessage
+
+
+class SharedObject(abc.ABC):
+    """Base class for all distributed data structures."""
+
+    def __init__(self, channel_id: str):
+        self.id = channel_id
+        self._runtime = None  # set on attach
+
+    # -- wiring ---------------------------------------------------------------
+
+    def attach(self, runtime) -> None:
+        self._runtime = runtime
+
+    @property
+    def client_id(self) -> int:
+        assert self._runtime is not None, "channel not attached"
+        return self._runtime.client_id
+
+    def submit_local_message(self, contents: Any, local_metadata: Any = None) -> None:
+        """Queue an op for sequencing (recorded in pending state for ack
+        matching — reference SharedObjectCore.submitLocalMessage)."""
+        assert self._runtime is not None, "channel not attached"
+        self._runtime.submit_channel_op(self.id, contents, local_metadata)
+
+    # -- the contract ---------------------------------------------------------
+
+    @abc.abstractmethod
+    def process_core(
+        self,
+        msg: SequencedDocumentMessage,
+        local: bool,
+        local_metadata: Optional[Any],
+    ) -> None:
+        """Apply one sequenced channel op. ``local`` means this is the ack of
+        our own op; ``local_metadata`` is what we recorded at submit time."""
+
+    @abc.abstractmethod
+    def summarize_core(self) -> dict:
+        """Produce this channel's summary blob(s)."""
+
+    @abc.abstractmethod
+    def load_core(self, summary: dict) -> None:
+        """Initialize state from a summary produced by summarize_core."""
+
+    def resubmit_core(self, contents: Any, local_metadata: Any) -> None:
+        """Regenerate a pending op after reconnect (reference reSubmitCore).
+        Default: resubmit as-is; sequence DDSes override to rebase."""
+        self.submit_local_message(contents, local_metadata)

@@ -1,0 +1,139 @@
+"""In-process ordering service — the LocalDeltaConnectionServer equivalent.
+
+Reference: ``server/routerlicious/packages/local-server`` +
+``memory-orderer/src/localOrderer.ts``: the full order-and-broadcast pipeline
+(alfred ingest → deli sequencing → scriptorium op log → broadcaster fan-out)
+wired in-process so clients and tests run without any cluster. Connections
+get per-client inboxes (the DeltaQueue analog) so tests can interleave
+delivery arbitrarily.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from fluidframework_tpu.protocol.types import (
+    DocumentMessage,
+    NackMessage,
+    SequencedDocumentMessage,
+    SignalMessage,
+)
+from fluidframework_tpu.service.sequencer import DocumentSequencer
+
+
+@dataclass
+class LocalConnection:
+    """One client's live connection to a document (delta stream)."""
+
+    doc_id: str
+    client_id: int
+    service: "LocalFluidService"
+    inbox: List[SequencedDocumentMessage] = field(default_factory=list)
+    signals: List[SignalMessage] = field(default_factory=list)
+    nacks: List[NackMessage] = field(default_factory=list)
+    on_nack: Optional[Callable[[NackMessage], None]] = None
+
+    def submit(self, msg: DocumentMessage) -> None:
+        self.service.submit(self.doc_id, self.client_id, msg)
+
+    def submit_signal(self, content) -> None:
+        self.service.submit_signal(self.doc_id, self.client_id, content)
+
+    def take_inbox(self, n: Optional[int] = None) -> List[SequencedDocumentMessage]:
+        """Pop up to n messages from the inbound queue, in order."""
+        n = len(self.inbox) if n is None else min(n, len(self.inbox))
+        out, self.inbox[:] = self.inbox[:n], self.inbox[n:]
+        return out
+
+    def disconnect(self) -> None:
+        self.service.disconnect(self.doc_id, self.client_id)
+
+
+class _DocState:
+    def __init__(self, doc_id: str):
+        self.sequencer = DocumentSequencer(doc_id)
+        self.op_log: List[SequencedDocumentMessage] = []  # scriptorium
+        self.connections: Dict[int, LocalConnection] = {}
+        self.signal_counter = 0
+
+
+class LocalFluidService:
+    """In-proc service endpoint: connect/submit/broadcast + durable op log."""
+
+    def __init__(self) -> None:
+        self.docs: Dict[str, _DocState] = {}
+
+    def _doc(self, doc_id: str) -> _DocState:
+        if doc_id not in self.docs:
+            self.docs[doc_id] = _DocState(doc_id)
+        return self.docs[doc_id]
+
+    # -- connection lifecycle (alfred connect_document, C.1) -----------------
+
+    def connect(self, doc_id: str, mode: str = "write") -> LocalConnection:
+        doc = self._doc(doc_id)
+        res = doc.sequencer.join(mode)
+        if isinstance(res, NackMessage):
+            raise ConnectionError(res.message)
+        client_id = res.contents
+        conn = LocalConnection(doc_id=doc_id, client_id=client_id, service=self)
+        # Catch-up: a fresh connection receives the full historical op stream
+        # first (no summaries yet in round 1 — the driver-storage fetch path),
+        # then live ops including its own join.
+        conn.inbox.extend(doc.op_log)
+        doc.connections[client_id] = conn
+        self._broadcast(doc, res)
+        return conn
+
+    def disconnect(self, doc_id: str, client_id: int) -> None:
+        doc = self._doc(doc_id)
+        doc.connections.pop(client_id, None)
+        leave = doc.sequencer.leave(client_id)
+        if leave is not None:
+            self._broadcast(doc, leave)
+
+    # -- op path (alfred submitOp -> deli -> broadcaster, §3.3) --------------
+
+    def submit(self, doc_id: str, client_id: int, msg: DocumentMessage) -> None:
+        doc = self._doc(doc_id)
+        res = doc.sequencer.ticket(client_id, msg)
+        if res is None:
+            return  # duplicate, dropped
+        if isinstance(res, NackMessage):
+            conn = doc.connections.get(client_id)
+            if conn is not None:
+                conn.nacks.append(res)
+                if conn.on_nack:
+                    conn.on_nack(res)
+            return
+        self._broadcast(doc, res)
+
+    def submit_signal(self, doc_id: str, client_id: int, content) -> None:
+        doc = self._doc(doc_id)
+        doc.signal_counter += 1
+        sig = SignalMessage(
+            client_id=client_id,
+            client_connection_number=doc.signal_counter,
+            content=content,
+        )
+        for conn in doc.connections.values():
+            conn.signals.append(sig)
+
+    def _broadcast(self, doc: _DocState, msg: SequencedDocumentMessage) -> None:
+        doc.op_log.append(msg)
+        for conn in doc.connections.values():
+            conn.inbox.append(msg)
+
+    # -- delta storage (historical op fetch, driver storage.ts:81) -----------
+
+    def get_deltas(
+        self, doc_id: str, from_seq: int = 0, to_seq: Optional[int] = None
+    ) -> List[SequencedDocumentMessage]:
+        log = self._doc(doc_id).op_log
+        return [
+            m
+            for m in log
+            if m.sequence_number > from_seq
+            and (to_seq is None or m.sequence_number <= to_seq)
+        ]

@@ -1,0 +1,192 @@
+"""The sequencer — deli semantics as a pure per-document state machine.
+
+TPU-native re-design of the reference's deli lambda
+(``server/routerlicious/packages/lambdas/src/deli/lambda.ts`` — ``ticket()``
+at :742, MSN calc :929-938, dedup/gap ``checkOrder`` :789-798, nack rules
+:864-893) and its per-client heap (``clientSeqManager.ts``).
+
+One :class:`DocumentSequencer` owns one document's total order: it validates
+inbound raw ops (dedup, gap, stale refSeq), assigns ``sequenceNumber``,
+maintains the client table and the minimum sequence number, and emits
+sequenced messages. It is deliberately pure/host-side — the ordering path is
+not device work; its output batches are what the TPU kernel consumes.
+
+Client slots are small ints (0..MAX_WRITERS-1) so sequenced ops lower
+directly to int32 kernel rows.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Union
+
+from fluidframework_tpu.protocol.constants import MAX_WRITERS
+from fluidframework_tpu.protocol.types import (
+    DocumentMessage,
+    MessageType,
+    NackErrorType,
+    NackMessage,
+    SequencedDocumentMessage,
+)
+
+
+@dataclass
+class _ClientEntry:
+    client_id: int
+    ref_seq: int
+    client_seq: int  # highest clientSequenceNumber seen
+    can_evict: bool = True
+    mode: str = "write"
+
+
+@dataclass
+class SequencerCheckpoint:
+    """Durable sequencer state (reference ``IDeliState``,
+    services-core/src/document.ts:56): enough to resume after a crash."""
+
+    sequence_number: int
+    minimum_sequence_number: int
+    clients: List[dict] = field(default_factory=list)
+    next_slot: int = 0
+
+
+class DocumentSequencer:
+    """Assigns the total order for one document (deli ``ticket()``)."""
+
+    def __init__(self, doc_id: str, checkpoint: Optional[SequencerCheckpoint] = None):
+        self.doc_id = doc_id
+        self.seq = 0
+        self.min_seq = 0
+        self.clients: Dict[int, _ClientEntry] = {}
+        self._next_slot = 0
+        if checkpoint is not None:
+            self.seq = checkpoint.sequence_number
+            self.min_seq = checkpoint.minimum_sequence_number
+            self._next_slot = checkpoint.next_slot
+            for c in checkpoint.clients:
+                self.clients[c["client_id"]] = _ClientEntry(**c)
+
+    # -- session management --------------------------------------------------
+
+    def join(self, mode: str = "write") -> Union[SequencedDocumentMessage, NackMessage]:
+        """Admit a client; returns the sequenced ClientJoin op.
+
+        The slot cap mirrors the kernel's removers bitmask width: deli's
+        1M-clients/doc cap (config.json:57) becomes MAX_WRITERS concurrent
+        write slots per document in round 1.
+        """
+        if self._next_slot >= MAX_WRITERS:
+            return NackMessage(
+                self.seq, 429, NackErrorType.LIMIT_EXCEEDED,
+                f"document writer slots exhausted ({MAX_WRITERS})",
+            )
+        slot = self._next_slot
+        self._next_slot += 1
+        msg = self._sequence_system(MessageType.CLIENT_JOIN, contents=slot)
+        # The new client's collab-window floor is the join op itself.
+        self.clients[slot] = _ClientEntry(
+            client_id=slot, ref_seq=msg.sequence_number, client_seq=0, mode=mode
+        )
+        return msg
+
+    def leave(self, client_id: int) -> Optional[SequencedDocumentMessage]:
+        if client_id not in self.clients:
+            return None
+        del self.clients[client_id]
+        return self._sequence_system(MessageType.CLIENT_LEAVE, contents=client_id)
+
+    # -- the ticket loop ------------------------------------------------------
+
+    def ticket(
+        self, client_id: int, msg: DocumentMessage
+    ) -> Union[SequencedDocumentMessage, NackMessage, None]:
+        """Sequence one raw client op. Returns the sequenced message, a nack,
+        or None for a duplicate (silently dropped, reference checkOrder)."""
+        entry = self.clients.get(client_id)
+        if entry is None:
+            return NackMessage(
+                self.seq, 400, NackErrorType.BAD_REQUEST, "unknown client"
+            )
+        if entry.mode != "write":
+            return NackMessage(
+                self.seq, 403, NackErrorType.INVALID_SCOPE, "read-only client"
+            )
+        # Duplicate: clientSequenceNumber at-or-below the highest seen.
+        if msg.client_sequence_number <= entry.client_seq:
+            return None
+        # Gap: the client skipped a clientSequenceNumber.
+        if msg.client_sequence_number != entry.client_seq + 1:
+            return NackMessage(
+                self.seq, 400, NackErrorType.BAD_REQUEST,
+                f"clientSequenceNumber gap (expected {entry.client_seq + 1})",
+            )
+        # Stale reference: below the collab window floor.
+        if msg.reference_sequence_number < self.min_seq:
+            return NackMessage(
+                self.seq, 400, NackErrorType.BAD_REQUEST,
+                f"refSeq {msg.reference_sequence_number} below MSN {self.min_seq}",
+            )
+        entry.client_seq = msg.client_sequence_number
+        entry.ref_seq = msg.reference_sequence_number
+
+        if msg.type == MessageType.NOOP:
+            # NoOps update the client table but do not consume a seq
+            # (deli lambda.ts:896-927); they still flush a fresh MSN.
+            return SequencedDocumentMessage(
+                client_id=client_id,
+                sequence_number=self.seq,
+                client_sequence_number=msg.client_sequence_number,
+                reference_sequence_number=msg.reference_sequence_number,
+                minimum_sequence_number=self._compute_msn(),
+                type=MessageType.NOOP,
+                contents=None,
+                timestamp=time.time(),
+                traces=list(msg.traces),
+            )
+
+        self.seq += 1
+        return SequencedDocumentMessage(
+            client_id=client_id,
+            sequence_number=self.seq,
+            client_sequence_number=msg.client_sequence_number,
+            reference_sequence_number=msg.reference_sequence_number,
+            minimum_sequence_number=self._compute_msn(),
+            type=msg.type,
+            contents=msg.contents,
+            timestamp=time.time(),
+            traces=list(msg.traces),
+        )
+
+    # -- internals ------------------------------------------------------------
+
+    def _compute_msn(self) -> int:
+        """MSN = min over per-client refSeq; no clients -> current seq
+        (deli lambda.ts:929-938). The MSN never regresses."""
+        if not self.clients:
+            msn = self.seq
+        else:
+            msn = min(c.ref_seq for c in self.clients.values())
+        self.min_seq = max(self.min_seq, msn)
+        return self.min_seq
+
+    def _sequence_system(self, ty: MessageType, contents) -> SequencedDocumentMessage:
+        self.seq += 1
+        return SequencedDocumentMessage(
+            client_id=-1,
+            sequence_number=self.seq,
+            client_sequence_number=-1,
+            reference_sequence_number=-1,
+            minimum_sequence_number=self._compute_msn(),
+            type=ty,
+            contents=contents,
+            timestamp=time.time(),
+        )
+
+    def checkpoint(self) -> SequencerCheckpoint:
+        return SequencerCheckpoint(
+            sequence_number=self.seq,
+            minimum_sequence_number=self.min_seq,
+            clients=[c.__dict__.copy() for c in self.clients.values()],
+            next_slot=self._next_slot,
+        )

@@ -1,0 +1,166 @@
+"""End-to-end: clients ⇄ in-proc ordering service ⇄ DDS channels.
+
+The layer-4 test of SURVEY.md §4: real runtime objects (ContainerRuntime +
+SharedString/SharedMap channels) against the in-process LocalFluidService,
+including randomized interleaving of flush/delivery (the farm pattern) and
+nack behavior.
+"""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.models.shared_map import SharedMap
+from fluidframework_tpu.models.shared_string import SharedString
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service.local_server import LocalFluidService
+
+ALPHABET = "abcdefghijklmnopqrstuvwxyz"
+
+
+def make_clients(service, doc_id, n, channel_factory):
+    return [
+        ContainerRuntime(service, doc_id, channels=(channel_factory(),))
+        for _ in range(n)
+    ]
+
+
+def drain_all(runtimes):
+    for rt in runtimes:
+        rt.flush()
+    busy = True
+    while busy:
+        busy = any(rt.process_incoming() for rt in runtimes)
+
+
+def test_two_client_string_convergence():
+    svc = LocalFluidService()
+    a, b = make_clients(svc, "doc", 2, lambda: SharedString("text"))
+    sa = a.get_channel("text")
+    sb = b.get_channel("text")
+
+    sa.insert_text(0, "hello")
+    a.flush()
+    drain_all([a, b])
+    assert sb.get_text() == "hello"
+
+    # Concurrent edits at the same position.
+    sa.insert_text(5, "!")
+    sb.insert_text(0, ">> ")
+    drain_all([a, b])
+    assert sa.get_text() == sb.get_text() == ">> hello!"
+
+
+def test_remove_and_annotate_convergence():
+    svc = LocalFluidService()
+    a, b = make_clients(svc, "doc", 2, lambda: SharedString("text"))
+    sa, sb = a.get_channel("text"), b.get_channel("text")
+    sa.insert_text(0, "abcdef")
+    drain_all([a, b])
+
+    sa.remove_range(1, 3)
+    sb.annotate(2, 5, 7)
+    drain_all([a, b])
+    assert sa.get_text() == sb.get_text() == "adef"
+    assert sa.annotations() == sb.annotations()
+
+
+def test_map_lww_and_pending_wins():
+    svc = LocalFluidService()
+    a, b = make_clients(svc, "doc", 2, lambda: SharedMap("map"))
+    ma, mb = a.get_channel("map"), b.get_channel("map")
+
+    ma.set("x", 1)
+    mb.set("x", 2)
+    # Before delivery each sees its own value.
+    assert ma.get("x") == 1 and mb.get("x") == 2
+    a.flush()
+    b.flush()
+    drain_all([a, b])
+    # b's set sequenced after a's -> LWW winner is 2, on both.
+    assert ma.get("x") == mb.get("x") == 2
+
+    ma.delete("x")
+    drain_all([a, b])
+    assert not ma.has("x") and not mb.has("x")
+
+
+def test_late_joiner_catches_up():
+    svc = LocalFluidService()
+    (a,) = make_clients(svc, "doc", 1, lambda: SharedString("text"))
+    sa = a.get_channel("text")
+    sa.insert_text(0, "state")
+    a.flush()
+    a.process_incoming()
+
+    b = ContainerRuntime(svc, "doc", channels=(SharedString("text"),))
+    assert b.get_channel("text").get_text() == "state"
+
+
+def test_nack_on_gap_surfaces():
+    svc = LocalFluidService()
+    (a,) = make_clients(svc, "doc", 1, lambda: SharedString("text"))
+    # Forge a gap by bumping client_seq manually.
+    a.get_channel("text").insert_text(0, "x")
+    a.client_seq += 5
+    a.flush()
+    assert a.connection.nacks and a.connection.nacks[0].content_code == 400
+
+
+def test_signals_fan_out():
+    svc = LocalFluidService()
+    a, b = make_clients(svc, "doc", 2, lambda: SharedMap("map"))
+    a.connection.submit_signal({"presence": "here"})
+    assert b.connection.signals[-1].content == {"presence": "here"}
+    assert a.connection.signals[-1].content == {"presence": "here"}
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_runtime_farm(seed):
+    """Randomized interleaving over the real service + runtime stack."""
+    rng = np.random.default_rng(seed + 100)
+    svc = LocalFluidService()
+    n = 3
+    rts = make_clients(svc, "doc", n, lambda: SharedString("text"))
+    strings = [rt.get_channel("text") for rt in rts]
+
+    for _ in range(120):
+        act = rng.integers(0, 4)
+        i = int(rng.integers(0, n))
+        rt, s = rts[i], strings[i]
+        length = len(s)
+        if act == 0:
+            k = int(rng.integers(1, 4))
+            s.insert_text(
+                int(rng.integers(0, length + 1)),
+                "".join(rng.choice(list(ALPHABET), k)),
+            )
+        elif act == 1 and length > 0:
+            x = int(rng.integers(0, length))
+            y = int(rng.integers(x + 1, min(length, x + 6) + 1))
+            s.remove_range(x, y)
+        elif act == 2:
+            rt.flush()
+        else:
+            rt.process_incoming(int(rng.integers(1, 6)))
+
+    drain_all(rts)
+    texts = [s.get_text() for s in strings]
+    assert all(t == texts[0] for t in texts), f"diverged: {texts}"
+    assert all(s.err_flags == 0 for s in strings)
+
+
+def test_summary_roundtrip_string():
+    svc = LocalFluidService()
+    a, b = make_clients(svc, "doc", 2, lambda: SharedString("text"))
+    sa = a.get_channel("text")
+    sa.insert_text(0, "hello world")
+    sa.annotate(0, 5, 3)
+    sa.remove_range(5, 6)
+    drain_all([a, b])
+
+    summary = a.summarize()
+    c = ContainerRuntime(svc, "doc2", channels=(SharedString("text"),))
+    sc = c.get_channel("text")
+    sc.load_core(summary["channels"]["text"])
+    assert sc.get_text() == sa.get_text() == "helloworld"
+    assert sc.annotations() == sa.annotations()

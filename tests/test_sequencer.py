@@ -1,0 +1,126 @@
+"""Sequencer (deli ticket) semantics tests — SURVEY.md Appendix C.2."""
+
+from fluidframework_tpu.protocol.types import (
+    DocumentMessage,
+    MessageType,
+    NackMessage,
+)
+from fluidframework_tpu.service.sequencer import DocumentSequencer
+
+
+def op(cseq, ref, contents=None, ty=MessageType.OPERATION):
+    return DocumentMessage(
+        client_sequence_number=cseq,
+        reference_sequence_number=ref,
+        type=ty,
+        contents=contents,
+    )
+
+
+def test_join_assigns_slots_and_sequences():
+    s = DocumentSequencer("d")
+    j0 = s.join()
+    j1 = s.join()
+    assert j0.contents == 0 and j1.contents == 1
+    assert (j0.sequence_number, j1.sequence_number) == (1, 2)
+    assert j0.type == MessageType.CLIENT_JOIN
+
+
+def test_sequence_and_msn():
+    s = DocumentSequencer("d")
+    c0 = s.join().contents
+    c1 = s.join().contents
+    m = s.ticket(c0, op(1, 2))
+    assert m.sequence_number == 3
+    # MSN = min refSeq over clients = min(2, join-time 2) = 2
+    assert m.minimum_sequence_number == 2
+    m2 = s.ticket(c1, op(1, 3))
+    assert m2.sequence_number == 4
+    assert m2.minimum_sequence_number == 2  # c0 still at refSeq 2
+
+
+def test_duplicate_dropped_and_gap_nacked():
+    s = DocumentSequencer("d")
+    c = s.join().contents
+    assert s.ticket(c, op(1, 1)).sequence_number == 2
+    assert s.ticket(c, op(1, 1)) is None  # duplicate
+    nack = s.ticket(c, op(3, 1))  # gap: skipped cseq 2
+    assert isinstance(nack, NackMessage) and nack.content_code == 400
+
+
+def test_stale_refseq_nacked():
+    s = DocumentSequencer("d")
+    c0 = s.join().contents
+    c1 = s.join().contents
+    s.ticket(c0, op(1, 2))
+    s.ticket(c1, op(1, 3))
+    # push MSN up: both clients advance
+    s.ticket(c0, op(2, 4))
+    s.ticket(c1, op(2, 5))
+    assert s.min_seq >= 4
+    nack = s.ticket(c0, op(3, 1))
+    assert isinstance(nack, NackMessage)
+    assert "below MSN" in nack.message
+
+
+def test_unknown_client_nacked():
+    s = DocumentSequencer("d")
+    nack = s.ticket(99, op(1, 0))
+    assert isinstance(nack, NackMessage)
+
+
+def test_read_client_cannot_write():
+    s = DocumentSequencer("d")
+    c = s.join(mode="read").contents
+    nack = s.ticket(c, op(1, 0))
+    assert isinstance(nack, NackMessage) and nack.content_code == 403
+
+
+def test_leave_advances_msn():
+    s = DocumentSequencer("d")
+    c0 = s.join().contents
+    c1 = s.join().contents
+    s.ticket(c0, op(1, 2))  # c0 refSeq 2, c1 refSeq 2 (join-time)
+    s.ticket(c1, op(1, 4))  # c1 refSeq 4
+    lv = s.leave(c0)
+    assert lv.minimum_sequence_number == 4  # only c1 remains
+
+
+def test_no_clients_msn_is_seq():
+    s = DocumentSequencer("d")
+    c = s.join().contents
+    s.ticket(c, op(1, 1))
+    lv = s.leave(c)
+    assert lv.minimum_sequence_number == lv.sequence_number
+
+
+def test_noop_does_not_consume_seq_but_updates_msn():
+    s = DocumentSequencer("d")
+    c0 = s.join().contents
+    c1 = s.join().contents
+    s.ticket(c0, op(1, 2))
+    before = s.seq
+    noop = s.ticket(c1, op(1, 3, ty=MessageType.NOOP))
+    assert s.seq == before
+    assert noop.type == MessageType.NOOP
+    assert noop.minimum_sequence_number == 2
+
+
+def test_msn_never_regresses():
+    s = DocumentSequencer("d")
+    c0 = s.join().contents
+    s.ticket(c0, op(1, 1))
+    lv_seq = s.min_seq
+    s.join()  # new client joins with refSeq = current seq
+    assert s.min_seq >= lv_seq
+
+
+def test_checkpoint_resume():
+    s = DocumentSequencer("d")
+    c0 = s.join().contents
+    s.ticket(c0, op(1, 1))
+    cp = s.checkpoint()
+    s2 = DocumentSequencer("d", cp)
+    m = s2.ticket(c0, op(2, 2))
+    assert m.sequence_number == s.seq + 1
+    assert s2.ticket(c0, op(2, 2)) is None  # dedup state survived
