@@ -1,0 +1,121 @@
+"""Benchmark: merge-op application throughput on one TPU chip.
+
+Implements BASELINE.md config 2 (batched op application across concurrent
+SharedString documents — the reference's ``Client.applyMsg`` hot path,
+merge-tree client.ts:858) at service scale. Prints ONE JSON line:
+``{"metric", "value", "unit", "vs_baseline", ...}`` where ``vs_baseline``
+is the ratio against the 1M ops/sec/chip north-star target (BASELINE.json).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def build_op_stream(n_docs: int, k: int, rng: np.random.Generator) -> np.ndarray:
+    """Valid sequenced op batches (insert/remove mix, fully-acked refs) with
+    per-doc variation, sized to keep the segment table bounded."""
+    from fluidframework_tpu.ops import encode as E
+    from fluidframework_tpu.protocol.constants import OP_WIDTH
+
+    ops = np.zeros((n_docs, k, OP_WIDTH), np.int32)
+    for d in range(min(n_docs, 16)):  # 16 distinct doc scripts, tiled
+        length = 0
+        seq = 0
+        for i in range(k - 1):
+            seq += 1
+            if length >= 8 and rng.random() < 0.45:
+                a = int(rng.integers(0, length - 2))
+                b = a + int(rng.integers(1, 3))
+                ops[d, i] = E.remove(a, b, seq=seq, ref=seq - 1, client=int(rng.integers(0, 8)))
+                length -= b - a
+            else:
+                ops[d, i] = E.insert(
+                    int(rng.integers(0, length + 1)), 1000 + i, 4,
+                    seq=seq, ref=seq - 1, client=int(rng.integers(0, 8)),
+                )
+                length += 4
+        # Close the script with a whole-document remove and advance the
+        # collab window past every stamp: after compaction the table is
+        # empty again, so the same stream replays validly forever (the
+        # steady-state a long-lived service document sees).
+        ops[d, k - 1] = E.remove(0, length, seq=k, ref=k - 1, client=0, msn=k)
+    for d in range(16, n_docs):
+        ops[d] = ops[d % 16]
+    return ops
+
+
+def cpu_oracle_baseline(ops_one_doc: np.ndarray) -> float:
+    """Single-doc pure-Python apply rate (the CPU comparison point; the
+    reference publishes no numbers, BASELINE.md)."""
+    from fluidframework_tpu.protocol.constants import NO_CLIENT
+    from fluidframework_tpu.testing.oracle import OracleDoc
+
+    doc = OracleDoc(NO_CLIENT)
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < 0.5:
+        d = OracleDoc(NO_CLIENT)
+        for row in ops_one_doc:
+            d.apply(row)
+        n += len(ops_one_doc)
+    return n / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    import jax
+
+    from fluidframework_tpu.ops.merge_kernel import batched_compact, jit_batched_apply_ops
+    from fluidframework_tpu.ops.segment_state import SegmentState, make_batched_state
+    from fluidframework_tpu.protocol.constants import NO_CLIENT
+
+    rng = np.random.default_rng(0)
+    n_docs, capacity, k = 2048, 256, 64
+    ops = build_op_stream(n_docs, k, rng)
+    jops = jax.device_put(ops)
+
+    state = make_batched_state(n_docs, capacity, NO_CLIENT)
+    # Warmup / compile both kernels.
+    state = jit_batched_apply_ops(state, jops)
+    state = batched_compact(state)
+    jax.block_until_ready(state)
+
+    iters = 20
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        state = jit_batched_apply_ops(state, jops)
+        state = batched_compact(state)
+        jax.block_until_ready(state)
+        times.append(time.perf_counter() - t0)
+    # Seq stamps in the replayed stream repeat, which is harmless for the
+    # apply cost; compaction each round keeps tables bounded like zamboni.
+    total_ops = n_docs * k * iters
+    elapsed = sum(times)
+    throughput = total_ops / elapsed
+    p99_batch_ms = float(np.percentile(np.array(times), 99) * 1e3)
+
+    errs = int(np.sum(np.asarray(state.err) != 0))
+    baseline = cpu_oracle_baseline(ops[0])
+
+    print(
+        json.dumps(
+            {
+                "metric": "merge_ops_per_sec_per_chip",
+                "value": round(throughput),
+                "unit": "ops/s",
+                "vs_baseline": round(throughput / 1_000_000, 4),
+                "n_docs": n_docs,
+                "ops_per_doc_per_step": k,
+                "p99_batch_ms": round(p99_batch_ms, 2),
+                "docs_with_errors": errs,
+                "cpu_oracle_ops_per_sec": round(baseline),
+                "device": str(jax.devices()[0]),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Document sharding across the TPU mesh.
+
+The scale-out story (SURVEY.md §2.6): the reference shards documents across
+Kafka partitions consumed by lambda hosts
+(``lambdas-driver/src/document-router/documentLambda.ts:20``, 8 partitions
+default). Here the analog is a ``jax.sharding.Mesh`` with a ``docs`` axis:
+the [D, ...] batched :class:`SegmentState` and the [D, K, W] op batches are
+sharded over it, op application runs fully parallel per document (no
+cross-document dependencies, so no collectives in the apply path), and only
+the telemetry/stats reduction crosses shards (an all-reduce that rides ICI).
+Multi-host extends the same axis over DCN — the sharding spec, not the
+kernel, changes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fluidframework_tpu.ops.merge_kernel import batched_apply_ops, batched_compact
+from fluidframework_tpu.ops.segment_state import SegmentState, make_batched_state
+from fluidframework_tpu.protocol.constants import NO_CLIENT
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "docs") -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def shard_state(state: SegmentState, mesh: Mesh, axis: str = "docs") -> SegmentState:
+    """Place a [D, ...] batched state with the doc axis sharded over the mesh."""
+    lane = NamedSharding(mesh, P(axis))
+    scalar = NamedSharding(mesh, P(axis))
+    return SegmentState(
+        *[
+            jax.device_put(x, lane if x.ndim == 2 else scalar)
+            for x in state
+        ]
+    )
+
+
+def shard_ops(ops: jnp.ndarray, mesh: Mesh, axis: str = "docs") -> jnp.ndarray:
+    return jax.device_put(ops, NamedSharding(mesh, P(axis)))
+
+
+def apply_and_stats(state: SegmentState, ops: jnp.ndarray):
+    """One sharded service step: apply each document's op batch, then reduce
+    fleet-wide telemetry (rows in use, error count, max seq) — the only
+    cross-shard communication in the pipeline."""
+    out = batched_apply_ops(state, ops)
+    stats = {
+        "rows_in_use": jnp.sum(out.count),
+        "docs_with_errors": jnp.sum((out.err != 0).astype(jnp.int32)),
+        "max_seq": jnp.max(out.cur_seq),
+        "min_window": jnp.min(out.min_seq),
+    }
+    return out, stats
+
+
+class DocShard:
+    """A mesh-resident fleet of documents — the compute backend the service
+    layer feeds with sequenced op batches (the ``TpuDeliLambda`` target)."""
+
+    def __init__(
+        self,
+        n_docs: int,
+        capacity: int,
+        mesh: Optional[Mesh] = None,
+        axis: str = "docs",
+    ):
+        self.mesh = mesh or make_mesh(axis=axis)
+        self.axis = axis
+        n_dev = self.mesh.devices.size
+        assert n_docs % n_dev == 0, (
+            f"n_docs={n_docs} must divide evenly over {n_dev} devices"
+        )
+        self.state = shard_state(
+            make_batched_state(n_docs, capacity, NO_CLIENT), self.mesh, axis
+        )
+        self._step = jax.jit(apply_and_stats, donate_argnums=(0,))
+
+    def apply(self, ops: np.ndarray):
+        """ops: [D, K, OP_WIDTH] int32 sequenced rows (NOOP-padded)."""
+        sharded = shard_ops(jnp.asarray(ops, jnp.int32), self.mesh, self.axis)
+        self.state, stats = self._step(self.state, sharded)
+        return stats
+
+    def compact(self) -> None:
+        self.state = batched_compact(self.state)
