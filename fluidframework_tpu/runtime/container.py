@@ -53,6 +53,9 @@ class ContainerRuntime:
         self.pending: deque = deque()
         self._outbox: list = []
         self.quorum_members: Dict[int, dict] = {}
+        # Quorum proposals: pending by seq; approved key -> value.
+        self.pending_proposals: Dict[int, tuple] = {}
+        self.approved_proposals: Dict[str, Any] = {}
         self.on_op: Optional[Callable[[SequencedDocumentMessage], None]] = None
         for ch in channels:
             self.create_channel(ch)
@@ -120,6 +123,16 @@ class ContainerRuntime:
             self.quorum_members[msg.contents] = {"client_id": msg.contents}
         elif msg.type == MessageType.CLIENT_LEAVE:
             self.quorum_members.pop(msg.contents, None)
+            for ch in self.channels.values():
+                ch.on_client_leave(msg.contents)
+            self._check_proposals()
+        elif msg.type == MessageType.PROPOSE:
+            # Quorum proposal (reference protocol-base/src/quorum.ts): keyed
+            # by its sequence number, approved once MSN reaches it (every
+            # connected client has seen it).
+            key, value = msg.contents["key"], msg.contents["value"]
+            self.pending_proposals[msg.sequence_number] = (key, value)
+            self._check_proposals()
         elif msg.type == MessageType.OPERATION:
             address = msg.contents["address"]
             inner = msg.contents["contents"]
@@ -141,8 +154,39 @@ class ContainerRuntime:
                     local,
                     local_metadata,
                 )
+        self._check_proposals()
         if self.on_op is not None:
             self.on_op(msg)
+
+    def send_noop(self) -> None:
+        """Flush our refSeq to the service so the MSN can advance (the
+        reference CollabWindowTracker's periodic noop)."""
+        self.client_seq += 1
+        self.connection.submit(
+            DocumentMessage(
+                client_sequence_number=self.client_seq,
+                reference_sequence_number=self.ref_seq,
+                type=MessageType.NOOP,
+            )
+        )
+
+    def propose(self, key: str, value: Any) -> None:
+        """Submit a quorum proposal (approved once MSN >= its seq)."""
+        self.client_seq += 1
+        self.connection.submit(
+            DocumentMessage(
+                client_sequence_number=self.client_seq,
+                reference_sequence_number=self.ref_seq,
+                type=MessageType.PROPOSE,
+                contents={"key": key, "value": value},
+            )
+        )
+
+    def _check_proposals(self) -> None:
+        for seq in sorted(self.pending_proposals):
+            if self.min_seq >= seq:
+                key, value = self.pending_proposals.pop(seq)
+                self.approved_proposals[key] = value
 
     # -- summaries (round-1 minimal: full snapshot, no incremental handles) ---
 

@@ -62,3 +62,6 @@ class SharedObject(abc.ABC):
         """Regenerate a pending op after reconnect (reference reSubmitCore).
         Default: resubmit as-is; sequence DDSes override to rebase."""
         self.submit_local_message(contents, local_metadata)
+
+    def on_client_leave(self, client_id: int) -> None:
+        """Quorum-departure hook (task reassignment, pact consent, ...)."""

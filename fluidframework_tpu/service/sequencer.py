@@ -130,21 +130,10 @@ class DocumentSequencer:
         entry.client_seq = msg.client_sequence_number
         entry.ref_seq = msg.reference_sequence_number
 
-        if msg.type == MessageType.NOOP:
-            # NoOps update the client table but do not consume a seq
-            # (deli lambda.ts:896-927); they still flush a fresh MSN.
-            return SequencedDocumentMessage(
-                client_id=client_id,
-                sequence_number=self.seq,
-                client_sequence_number=msg.client_sequence_number,
-                reference_sequence_number=msg.reference_sequence_number,
-                minimum_sequence_number=self._compute_msn(),
-                type=MessageType.NOOP,
-                contents=None,
-                timestamp=time.time(),
-                traces=list(msg.traces),
-            )
-
+        # Unlike the reference (deli lambda.ts:896-927 leaves NoOps
+        # un-sequenced and coalesces them), NOOPs here consume a sequence
+        # number like any op: clients then see a strictly gapless stream,
+        # which keeps the device-side scan and the dedup rules uniform.
         self.seq += 1
         return SequencedDocumentMessage(
             client_id=client_id,

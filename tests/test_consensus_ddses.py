@@ -1,0 +1,151 @@
+"""Tests for the consensus-family DDSes (cell, counter, registers, queue,
+task manager, pact map) and quorum proposals — SURVEY.md §2.2 inventory."""
+
+from fluidframework_tpu.models.consensus_register import ConsensusRegisterCollection
+from fluidframework_tpu.models.ordered_collection import ConsensusOrderedCollection
+from fluidframework_tpu.models.pact_map import PactMap
+from fluidframework_tpu.models.shared_cell import SharedCell
+from fluidframework_tpu.models.shared_counter import SharedCounter
+from fluidframework_tpu.models.task_manager import TaskManager
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service.local_server import LocalFluidService
+
+
+def pair(factory):
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "doc", channels=(factory(),))
+    b = ContainerRuntime(svc, "doc", channels=(factory(),))
+    return svc, a, b
+
+
+def drain(*rts):
+    busy = True
+    while busy:
+        busy = any(rt.process_incoming() for rt in rts)
+
+
+def test_cell_lww_and_pending_wins():
+    _, a, b = pair(lambda: SharedCell("c"))
+    ca, cb = a.get_channel("c"), b.get_channel("c")
+    ca.set(1)
+    cb.set(2)
+    a.flush()
+    b.flush()
+    drain(a, b)
+    assert ca.get() == cb.get() == 2
+    cb.delete()
+    drain(a, b)
+    assert ca.empty and cb.empty
+
+
+def test_counter_commutes():
+    _, a, b = pair(lambda: SharedCounter("n"))
+    na, nb = a.get_channel("n"), b.get_channel("n")
+    na.increment(5)
+    nb.increment(-2)
+    na.increment(1)
+    drain(a, b)
+    assert na.value == nb.value == 4
+
+
+def test_register_consensus_no_optimism():
+    _, a, b = pair(lambda: ConsensusRegisterCollection("r"))
+    ra, rb = a.get_channel("r"), b.get_channel("r")
+    ra.write("k", "A")
+    assert ra.read("k") is None  # not applied until sequenced
+    drain(a, b)
+    assert ra.read("k") == rb.read("k") == "A"
+
+
+def test_register_concurrent_versions():
+    _, a, b = pair(lambda: ConsensusRegisterCollection("r"))
+    ra, rb = a.get_channel("r"), b.get_channel("r")
+    ra.write("k", "A")
+    rb.write("k", "B")  # concurrent: same refSeq
+    a.flush()
+    b.flush()
+    drain(a, b)
+    # Later-sequenced write wins the read; both versions retained.
+    assert ra.read("k") == rb.read("k") == "B"
+    assert set(ra.read_versions("k")) == {"A", "B"}
+    # A later non-concurrent write supersedes both.
+    ra.write("k", "C")
+    drain(a, b)
+    assert rb.read_versions("k") == ["C"]
+
+
+def test_ordered_collection_single_acquirer():
+    _, a, b = pair(lambda: ConsensusOrderedCollection("q"))
+    qa, qb = a.get_channel("q"), b.get_channel("q")
+    qa.add("job1")
+    drain(a, b)
+    qa.acquire()
+    qb.acquire()  # concurrent: only the first sequenced acquire wins
+    a.flush()
+    b.flush()
+    drain(a, b)
+    assert len(qa.acquired()) == 1 and len(qb.acquired()) == 0
+    assert qa.size() == qb.size() == 0
+    item_id = next(iter(qa.acquired()))
+    qa.release(item_id)
+    drain(a, b)
+    assert qa.size() == qb.size() == 1  # back at the front
+    assert not qa.acquired()
+
+
+def test_task_manager_queue_and_leave():
+    svc, a, b = pair(lambda: TaskManager("t"))
+    ta, tb = a.get_channel("t"), b.get_channel("t")
+    ta.volunteer("summarizer")
+    drain(a, b)
+    tb.volunteer("summarizer")
+    drain(a, b)
+    assert ta.assigned("summarizer") and not tb.assigned("summarizer")
+    assert tb.queued("summarizer")
+    # The holder disconnects: the task passes to the next in queue.
+    a.connection.disconnect()
+    drain(b)
+    assert tb.assigned("summarizer")
+
+
+def test_pact_map_unanimous_consent():
+    svc, a, b = pair(lambda: PactMap("p"))
+    pa, pb = a.get_channel("p"), b.get_channel("p")
+    pa.set("mode", "strict")
+    a.flush()
+    # Sequenced but b has not accepted yet.
+    a.process_incoming()
+    assert pa.get("mode") is None and pa.get_pending("mode") == "strict"
+    drain(a, b)  # b processes the set, auto-accepts; accept sequences
+    assert pa.get("mode") == pb.get("mode") == "strict"
+
+
+def test_pact_map_leave_counts_as_consent():
+    svc, a, b = pair(lambda: PactMap("p"))
+    pa = a.get_channel("p")
+    pa.set("mode", "loose")
+    a.flush()
+    a.process_incoming()
+    assert pa.get("mode") is None
+    b.connection.disconnect()  # b never accepted; its departure consents
+    drain(a)
+    assert pa.get("mode") == "loose"
+
+
+def test_quorum_proposal_approval_via_msn():
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "doc")
+    b = ContainerRuntime(svc, "doc")
+    a.propose("code", "v2")
+    drain(a, b)
+    # MSN has not caught up to the proposal seq yet.
+    assert "code" not in a.approved_proposals
+    # Both clients flush their refSeq via noops -> MSN advances -> approval.
+    a.send_noop()
+    b.send_noop()
+    drain(a, b)
+    a.send_noop()
+    b.send_noop()
+    drain(a, b)
+    assert a.approved_proposals.get("code") == "v2"
+    assert b.approved_proposals.get("code") == "v2"

@@ -94,14 +94,14 @@ def test_no_clients_msn_is_seq():
     assert lv.minimum_sequence_number == lv.sequence_number
 
 
-def test_noop_does_not_consume_seq_but_updates_msn():
+def test_noop_consumes_seq_and_updates_msn():
     s = DocumentSequencer("d")
     c0 = s.join().contents
     c1 = s.join().contents
     s.ticket(c0, op(1, 2))
     before = s.seq
     noop = s.ticket(c1, op(1, 3, ty=MessageType.NOOP))
-    assert s.seq == before
+    assert s.seq == before + 1  # gapless stream: noops are sequenced too
     assert noop.type == MessageType.NOOP
     assert noop.minimum_sequence_number == 2
 
