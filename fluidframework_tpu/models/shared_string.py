@@ -173,6 +173,81 @@ class SharedString(SharedObject):
             if int(to_host(self._state).count) > cap - 8:
                 self._state = grow(self._state, cap * 2)
 
+    # -- reconnect rebase (reference regeneratePendingOp, client.ts:917) ------
+
+    def on_reconnect(self, new_client_id: int) -> None:
+        import jax.numpy as jnp
+
+        self._state = self._state._replace(self_client=jnp.int32(new_client_id))
+
+    def begin_resubmit(self) -> None:
+        # All regenerations in one batch read the reconnect-time state;
+        # restamps land on the live state without perturbing the view.
+        self._rebase_view = to_host(self._state)
+
+    def end_resubmit(self) -> None:
+        self._rebase_view = None
+
+    def _restamp(self, lane: str, rows: list, new_value: int) -> None:
+        import jax.numpy as jnp
+
+        arr = np.asarray(getattr(self._state, lane)).copy()
+        arr[rows] = new_value
+        self._state = self._state._replace(**{lane: jnp.asarray(arr)})
+
+    def resubmit_core(self, contents: Any, local_metadata: Any) -> None:
+        from fluidframework_tpu.runtime.rebase import (
+            regen_annotate,
+            regen_insert,
+            regen_remove,
+        )
+
+        kind, L = local_metadata["kind"], local_metadata["lseq"]
+        h = getattr(self, "_rebase_view", None) or to_host(self._state)
+        if kind == "insert":
+            runs = regen_insert(h, L)
+            for run in runs:
+                self._lseq += 1
+                text = "".join(
+                    self._payloads[int(h.orig[i])][
+                        int(h.off[i]) : int(h.off[i]) + int(h.length[i])
+                    ]
+                    for i in run.rows
+                )
+                self._restamp("lseq", run.rows, self._lseq)
+                self.submit_local_message(
+                    {
+                        "k": "ins",
+                        "pos": run.pos,
+                        "text": text,
+                        "orig": contents["orig"],
+                    },
+                    {"kind": "insert", "lseq": self._lseq},
+                )
+        elif kind == "remove":
+            for run in regen_remove(h, L):
+                self._lseq += 1
+                self._restamp("rlseq", run.rows, self._lseq)
+                self.submit_local_message(
+                    {"k": "rem", "start": run.pos, "end": run.pos + run.span},
+                    {"kind": "remove", "lseq": self._lseq},
+                )
+        elif kind == "annotate":
+            for run in regen_annotate(h, L):
+                self._lseq += 1
+                self._restamp("alseq", run.rows, self._lseq)
+                self.submit_local_message(
+                    {
+                        "k": "ann",
+                        "start": run.pos,
+                        "end": run.pos + run.span,
+                        "val": contents["val"],
+                    },
+                    {"kind": "annotate", "lseq": self._lseq},
+                )
+        else:
+            raise ValueError(f"unknown resubmit kind {kind!r}")
+
     # -- summary / load (round-1: full state snapshot) ------------------------
 
     def summarize_core(self) -> dict:

@@ -77,6 +77,7 @@ class NackMessage:
     error_type: NackErrorType
     message: str = ""
     retry_after_s: float = 0.0
+    client_sequence_number: int = -1  # the rejected op, for resubmission
 
 
 @dataclass

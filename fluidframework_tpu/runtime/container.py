@@ -42,12 +42,18 @@ class ContainerRuntime:
         exist before catch-up so historical channel ops have a target.
         """
         self.doc_id = doc_id
+        self._service = service
+        self._mode = mode
+        self.connected = True
         self.connection = service.connect(doc_id, mode)
         self.client_id = self.connection.client_id
+        self._my_ids = {self.client_id}  # this + prior connections' ids
+        self._offline: list = []  # ops authored while disconnected
         self.channels: Dict[str, SharedObject] = {}
         self.ref_seq = 0  # last processed sequence number
         self.min_seq = 0
         self.client_seq = 0  # outbound clientSequenceNumber
+        self._last_acked_cseq = 0  # highest own cseq seen sequenced
         # FIFO of (client_seq, channel_id, contents, local_metadata):
         # reference PendingStateManager semantics.
         self.pending: deque = deque()
@@ -80,8 +86,13 @@ class ContainerRuntime:
         self._outbox.append((channel_id, contents, local_metadata))
 
     def flush(self) -> None:
-        """Send the accumulated batch (the JS-turn-end flush)."""
+        """Send the accumulated batch (the JS-turn-end flush). While
+        disconnected, ops buffer for regeneration at reconnect (the
+        reference's stashed/pending-state offline flow)."""
         batch, self._outbox = self._outbox, []
+        if not self.connected:
+            self._offline.extend(batch)
+            return
         n = len(batch)
         for i, (channel_id, contents, local_metadata) in enumerate(batch):
             self.client_seq += 1
@@ -110,6 +121,41 @@ class ContainerRuntime:
         msgs = self.connection.take_inbox(n)
         for msg in msgs:
             self._process_one(msg)
+        # Nack recovery (reference: nack -> resubmit, §5.3): after a nack,
+        # nothing from this connection sequences until we resend, so the
+        # entire pending tail regenerates against the caught-up state.
+        guard = 0
+        while self.connection.nacks and self.connected:
+            guard += 1
+            assert guard < 8, "nack resubmission did not converge"
+            self.connection.nacks.clear()
+            for m in self.connection.take_inbox():
+                self._process_one(m)
+            # Rejected clientSequenceNumbers are reused: the server's per-
+            # client counter only advances on sequenced ops.
+            self.client_seq = self._last_acked_cseq
+            tail = list(self.pending)
+            self.pending.clear()
+            for ch in self.channels.values():
+                ch.begin_resubmit()
+            for _cseq, channel_id, contents, local_metadata in tail:
+                self.channels[channel_id].resubmit_core(contents, local_metadata)
+            for ch in self.channels.values():
+                ch.end_resubmit()
+            batch, self._outbox = self._outbox, []
+            for i, (channel_id, contents, local_metadata) in enumerate(batch):
+                self.client_seq += 1
+                self.pending.append(
+                    (self.client_seq, channel_id, contents, local_metadata)
+                )
+                self.connection.submit(
+                    DocumentMessage(
+                        client_sequence_number=self.client_seq,
+                        reference_sequence_number=self.ref_seq,
+                        type=MessageType.OPERATION,
+                        contents={"address": channel_id, "contents": contents},
+                    )
+                )
         return len(msgs)
 
     def _process_one(self, msg: SequencedDocumentMessage) -> None:
@@ -136,7 +182,7 @@ class ContainerRuntime:
         elif msg.type == MessageType.OPERATION:
             address = msg.contents["address"]
             inner = msg.contents["contents"]
-            local = msg.client_id == self.client_id
+            local = msg.client_id in self._my_ids
             local_metadata = None
             if local:
                 assert self.pending, "ack with no pending op"
@@ -145,6 +191,7 @@ class ContainerRuntime:
                     f"pending mismatch: {pseq} != {msg.client_sequence_number}"
                 )
                 assert pchan == address
+                self._last_acked_cseq = msg.client_sequence_number
             channel = self.channels.get(address)
             if channel is not None:
                 channel.process_core(
@@ -157,6 +204,43 @@ class ContainerRuntime:
         self._check_proposals()
         if self.on_op is not None:
             self.on_op(msg)
+
+    # -- connection lifecycle (disconnect / reconnect + resubmit, §5.3) ------
+
+    def disconnect(self) -> None:
+        """Drop the connection. In-flight state drains first (the local
+        service sequences synchronously, so pending acks are already in the
+        inbox); edits made while disconnected buffer for resubmission."""
+        self.flush()
+        self.process_incoming()
+        assert not self.pending, "pending ops must drain before disconnect"
+        self.connection.disconnect()
+        self.connected = False
+
+    def reconnect(self) -> None:
+        """Rejoin under a new client id, catch up, then regenerate offline
+        edits through each channel's resubmit path (reference
+        regeneratePendingOp / reSubmitCore)."""
+        assert not self.connected, "already connected"
+        self.connection = self._service.connect(
+            self.doc_id, self._mode, from_seq=self.ref_seq
+        )
+        self.client_id = self.connection.client_id
+        self._my_ids.add(self.client_id)
+        self.client_seq = 0  # clientSequenceNumbers are per-connection
+        self._last_acked_cseq = 0
+        self.connected = True
+        for ch in self.channels.values():
+            ch.on_reconnect(self.client_id)
+        offline, self._offline = self._offline, []
+        self.process_incoming()  # catch up before rebasing
+        for ch in self.channels.values():
+            ch.begin_resubmit()
+        for channel_id, contents, local_metadata in offline:
+            self.channels[channel_id].resubmit_core(contents, local_metadata)
+        for ch in self.channels.values():
+            ch.end_resubmit()
+        self.flush()
 
     def send_noop(self) -> None:
         """Flush our refSeq to the service so the MSN can advance (the

@@ -65,3 +65,14 @@ class SharedObject(abc.ABC):
 
     def on_client_leave(self, client_id: int) -> None:
         """Quorum-departure hook (task reassignment, pact consent, ...)."""
+
+    def on_reconnect(self, new_client_id: int) -> None:
+        """Connection-change hook: kernel-backed DDSes update their local
+        client slot so new local ops stamp correctly."""
+
+    def begin_resubmit(self) -> None:
+        """Marks the start of a resubmit batch: rebase computations must all
+        read the state as of reconnect, not interleaved restamps."""
+
+    def end_resubmit(self) -> None:
+        """Marks the end of a resubmit batch."""

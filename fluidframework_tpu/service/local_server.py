@@ -71,17 +71,22 @@ class LocalFluidService:
 
     # -- connection lifecycle (alfred connect_document, C.1) -----------------
 
-    def connect(self, doc_id: str, mode: str = "write") -> LocalConnection:
+    def connect(
+        self, doc_id: str, mode: str = "write", from_seq: int = 0
+    ) -> LocalConnection:
         doc = self._doc(doc_id)
         res = doc.sequencer.join(mode)
         if isinstance(res, NackMessage):
             raise ConnectionError(res.message)
         client_id = res.contents
         conn = LocalConnection(doc_id=doc_id, client_id=client_id, service=self)
-        # Catch-up: a fresh connection receives the full historical op stream
-        # first (no summaries yet in round 1 — the driver-storage fetch path),
+        # Catch-up: the connection receives the historical op stream after
+        # ``from_seq`` (reconnecting clients resume where they left off; a
+        # fresh client replays everything — the driver-storage fetch path),
         # then live ops including its own join.
-        conn.inbox.extend(doc.op_log)
+        conn.inbox.extend(
+            m for m in doc.op_log if m.sequence_number > from_seq
+        )
         doc.connections[client_id] = conn
         self._broadcast(doc, res)
         return conn

@@ -120,12 +120,14 @@ class DocumentSequencer:
             return NackMessage(
                 self.seq, 400, NackErrorType.BAD_REQUEST,
                 f"clientSequenceNumber gap (expected {entry.client_seq + 1})",
+                client_sequence_number=msg.client_sequence_number,
             )
         # Stale reference: below the collab window floor.
         if msg.reference_sequence_number < self.min_seq:
             return NackMessage(
                 self.seq, 400, NackErrorType.BAD_REQUEST,
                 f"refSeq {msg.reference_sequence_number} below MSN {self.min_seq}",
+                client_sequence_number=msg.client_sequence_number,
             )
         entry.client_seq = msg.client_sequence_number
         entry.ref_seq = msg.reference_sequence_number

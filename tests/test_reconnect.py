@@ -1,0 +1,154 @@
+"""Reconnect/resubmit tests: offline edits rebase onto the current state
+(reference regeneratePendingOp + reSubmitCore semantics, SURVEY §5.3)."""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.models.shared_map import SharedMap
+from fluidframework_tpu.models.shared_string import SharedString
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service.local_server import LocalFluidService
+
+ALPHABET = "abcdefghijklmnopqrstuvwxyz"
+
+
+def setup(n=2, channel=lambda: SharedString("text")):
+    svc = LocalFluidService()
+    rts = [ContainerRuntime(svc, "doc", channels=(channel(),)) for _ in range(n)]
+    return svc, rts
+
+
+def drain(rts):
+    busy = True
+    while busy:
+        busy = any(rt.process_incoming() for rt in rts if rt.connected)
+
+
+def test_offline_insert_rebases():
+    svc, (a, b) = setup()
+    sa, sb = a.get_channel("text"), b.get_channel("text")
+    sa.insert_text(0, "hello world")
+    drain([a, b])
+
+    a.disconnect()
+    sa.insert_text(5, "!")  # offline edit at "hello|!| world"
+    sb.insert_text(0, ">> ")  # concurrent edit while a is away
+    drain([b])
+    a.reconnect()
+    drain([a, b])
+    assert sa.get_text() == sb.get_text() == ">> hello! world"
+
+
+def test_offline_remove_rebases():
+    svc, (a, b) = setup()
+    sa, sb = a.get_channel("text"), b.get_channel("text")
+    sa.insert_text(0, "abcdef")
+    drain([a, b])
+
+    a.disconnect()
+    sa.remove_range(2, 4)  # remove "cd" offline
+    sb.insert_text(0, "XY")  # shift positions while a is away
+    drain([b])
+    a.reconnect()
+    drain([a, b])
+    assert sa.get_text() == sb.get_text() == "XYabef"
+
+
+def test_offline_remove_superseded_by_remote():
+    svc, (a, b) = setup()
+    sa, sb = a.get_channel("text"), b.get_channel("text")
+    sa.insert_text(0, "abcdef")
+    drain([a, b])
+
+    a.disconnect()
+    sa.remove_range(1, 5)  # offline remove "bcde"
+    sb.remove_range(2, 4)  # remote removes "cd" first
+    drain([b])
+    a.reconnect()
+    drain([a, b])
+    assert sa.get_text() == sb.get_text() == "af"
+
+
+def test_offline_insert_then_remove():
+    svc, (a, b) = setup()
+    sa, sb = a.get_channel("text"), b.get_channel("text")
+    sa.insert_text(0, "base")
+    drain([a, b])
+
+    a.disconnect()
+    sa.insert_text(4, "-tail")
+    sa.remove_range(0, 2)  # "base-tail" -> "se-tail"
+    sa.remove_range(2, 4)  # "se-tail" -> "seail" (spans acked + offline text)
+    a.reconnect()
+    drain([a, b])
+    assert sa.get_text() == sb.get_text() == "seail"
+
+
+def test_offline_annotate_rebases():
+    svc, (a, b) = setup()
+    sa, sb = a.get_channel("text"), b.get_channel("text")
+    sa.insert_text(0, "abcdef")
+    drain([a, b])
+
+    a.disconnect()
+    sa.annotate(1, 4, 9)
+    sb.insert_text(0, "ZZ")
+    drain([b])
+    a.reconnect()
+    drain([a, b])
+    assert sa.get_text() == sb.get_text()
+    assert sa.annotations() == sb.annotations() == [(3, 6, 9)]
+
+
+def test_map_offline_resubmit():
+    svc, (a, b) = setup(channel=lambda: SharedMap("m"))
+    ma, mb = a.get_channel("m"), b.get_channel("m")
+    ma.set("x", 1)
+    drain([a, b])
+    a.disconnect()
+    ma.set("x", 2)
+    mb.set("y", 3)
+    drain([b])
+    a.reconnect()
+    drain([a, b])
+    assert ma.get("x") == mb.get("x") == 2
+    assert ma.get("y") == mb.get("y") == 3
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_reconnect_farm(seed):
+    rng = np.random.default_rng(seed + 900)
+    svc, rts = setup(3)
+    strings = [rt.get_channel("text") for rt in rts]
+    strings[0].insert_text(0, "seed")
+    drain(rts)
+
+    for step in range(80):
+        i = int(rng.integers(0, 3))
+        rt, s = rts[i], strings[i]
+        act = rng.integers(0, 6)
+        length = len(s)
+        if act == 0:
+            s.insert_text(
+                int(rng.integers(0, length + 1)),
+                "".join(rng.choice(list(ALPHABET), int(rng.integers(1, 4)))),
+            )
+        elif act == 1 and length > 2:
+            x = int(rng.integers(0, length - 1))
+            s.remove_range(x, x + int(rng.integers(1, min(4, length - x) + 1)))
+        elif act == 2 and rt.connected:
+            rt.flush()
+        elif act == 3 and rt.connected:
+            rt.process_incoming(int(rng.integers(1, 5)))
+        elif act == 4 and rt.connected and sum(r.connected for r in rts) > 1:
+            rt.disconnect()
+        elif act == 5 and not rt.connected:
+            rt.reconnect()
+
+    for rt in rts:
+        if not rt.connected:
+            rt.reconnect()
+    drain(rts)
+    texts = [s.get_text() for s in strings]
+    assert all(t == texts[0] for t in texts), f"diverged: {texts}"
+    assert all(s.err_flags == 0 for s in strings)
