@@ -1,0 +1,3 @@
+from fluidframework_tpu.tree import marks  # noqa: F401
+from fluidframework_tpu.tree.edit_manager import Commit, EditManager  # noqa: F401
+from fluidframework_tpu.tree.shared_tree import SharedTree  # noqa: F401

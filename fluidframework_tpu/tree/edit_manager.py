@@ -1,0 +1,279 @@
+"""EditManager — trunk/branch changeset merging for SharedTree.
+
+Reference: ``packages/dds/tree/src/core/edit-manager/editManager.ts``
+(SURVEY.md Appendix B.2). State is a *trunk* of sequenced commits, a
+per-session *mirror branch* reconstructing that session's authoring view,
+and the local display *view* (trunk + our unacked edits).
+
+Where the reference rebases with a sandwich compose over chain inverses —
+made sound there by ChangeAtomIds + lineage marks — this design reaches the
+same convergence with **cell identity + anchor transport**:
+
+- Every inserted item is a *cell* ``(id, value)`` with a globally-unique id.
+- A commit's positional marks are decoded against the author's mirrored
+  view (reconstructed purely from the sequenced stream, so identical on
+  every replica) into id-operations: delete-by-id (already-deleted targets
+  no-op — overlapping removes) and insert runs anchored after the nearest
+  left neighbor surviving on the trunk, found by walking leftward through
+  the author's post-edit view (the lineage analog).
+- Those id-operations apply to *any* superset sequence — the trunk, every
+  mirror, and the local view all consume the same decoded ops, so no
+  positional rebase (and no inverse composition) exists anywhere on the
+  ingest path. Later-sequenced runs land closer to their anchor and pending
+  local cells stay left of incoming runs (merge-tree tie ordering).
+- The trunk form is the positional diff of the trunk cell list — a pure
+  function of agreed data, so every replica derives the identical commit.
+
+Inversion is used only to rewind concrete cell lists to an older trunk seq
+(mirror creation), where it is exact.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from fluidframework_tpu.tree import marks as M
+
+Cell = Tuple[int, object]  # (cell id, value)
+Run = Tuple[Optional[int], List[Cell]]  # (anchor cell id or None=front, cells)
+
+
+@dataclass
+class Commit:
+    session: int
+    seq: int
+    ref: int
+    change: M.Changeset  # positional marks over the author's view
+
+
+@dataclass
+class TrunkCommit:
+    session: int
+    seq: int
+    ref: int
+    wire: M.Changeset  # authored form (mirror replay)
+    trunk_change: M.Changeset  # positional over trunk-before (rewind/apply)
+    deleted_ids: Set[int]
+    runs: List[Run]
+    order_after: List[int]  # trunk cell ids after this commit
+
+
+@dataclass
+class _Branch:
+    base: int  # trunk seq this mirror has integrated
+    chain: List[M.Changeset] = field(default_factory=list)  # wire forms in flight
+    chain_seqs: List[int] = field(default_factory=list)
+    state: List[Cell] = field(default_factory=list)  # the session's view
+
+
+def apply_ops_to_view(
+    view: List[Cell],
+    deleted_ids: Set[int],
+    runs: List[Run],
+    order_after: List[int],
+) -> List[Cell]:
+    """Apply a trunk commit's id-operations to a view that may carry extra
+    pending cells and miss locally-deleted ones. Pending (non-trunk) cells
+    directly after an anchor stay left of the incoming run (they will
+    sequence later — merge-tree tie ordering); runs already present (our own
+    echo) are skipped; deletes are idempotent."""
+    trunk_ids = set(order_after)
+    out = [c for c in view if c[0] not in deleted_ids]
+    present = {c[0] for c in out}
+    for anchor, cells in runs:
+        if cells and cells[0][0] in present:
+            continue  # own echo: the run is already placed
+        pos = 0
+        if anchor is not None:
+            pos_found = None
+            ai = order_after.index(anchor)
+            for j in range(ai, -1, -1):
+                cid = order_after[j]
+                hit = next((k for k, c in enumerate(out) if c[0] == cid), None)
+                if hit is not None:
+                    pos_found = hit + 1
+                    break
+            pos = 0 if pos_found is None else pos_found
+        while pos < len(out) and out[pos][0] not in trunk_ids:
+            pos += 1  # pending local cells keep their left-of-incoming spot
+        out[pos:pos] = cells
+        present.update(c[0] for c in cells)
+    return out
+
+
+class EditManager:
+    def __init__(self, session: int):
+        self.session = session
+        self.trunk: List[TrunkCommit] = []
+        self.trunk_state: List[Cell] = []
+        self.branches: Dict[int, _Branch] = {}
+        self.trunk_seq = 0
+        self.view_state: List[Cell] = []
+        self.inflight = 0  # our unacked commit count
+
+    # -- authoring / view -----------------------------------------------------
+
+    def add_local(self, change: M.Changeset) -> None:
+        """Record a locally-authored change (positional over the view)."""
+        self.view_state = M.apply(self.view_state, change)
+        self.inflight += 1
+
+    def local_view(self) -> List[Cell]:
+        return list(self.view_state)
+
+    def set_session(self, session: int) -> None:
+        self.session = session
+
+    def reset_inflight(self, n: int) -> None:
+        """Resubmission squashed the pending ops into n wire messages."""
+        self.inflight = n
+
+    # -- sequenced ingest -----------------------------------------------------
+
+    def add_sequenced(self, commit: Commit) -> M.Changeset:
+        """Ingest one sequenced commit; returns its trunk form."""
+        b = self.branches.get(commit.session)
+        if b is None:
+            b = self.branches[commit.session] = _Branch(
+                base=commit.ref, state=self._state_at(commit.ref)
+            )
+        else:
+            self._advance_branch(b, commit.ref)
+
+        tc = self._transport(commit, b.state)
+
+        b.chain.append(commit.change)
+        b.chain_seqs.append(commit.seq)
+        b.state = M.apply(b.state, commit.change)
+
+        self.trunk.append(tc)
+        self.trunk_state = M.apply(self.trunk_state, tc.trunk_change)
+        self.trunk_seq = commit.seq
+
+        # Local display view: own echoes change nothing (their effect is
+        # already in the view — including edits we later undid locally);
+        # concurrent commits consume the same id-operations as the trunk.
+        if commit.session == self.session:
+            self.inflight -= 1
+        else:
+            self.view_state = apply_ops_to_view(
+                self.view_state, tc.deleted_ids, tc.runs, tc.order_after
+            )
+        if self.inflight == 0:
+            self.view_state = list(self.trunk_state)  # exact resync
+        return tc.trunk_change
+
+    def advance_min_seq(self, min_seq: int) -> None:
+        """Prune trunk commits at or below the collab-window floor; drop
+        mirror branches that are fully integrated behind it."""
+        self.trunk = [c for c in self.trunk if c.seq > min_seq]
+        for session in list(self.branches):
+            b = self.branches[session]
+            if b.base <= min_seq and all(s <= min_seq for s in b.chain_seqs):
+                del self.branches[session]
+
+    # -- internals ------------------------------------------------------------
+
+    def _state_at(self, seq: int) -> List[Cell]:
+        """Concrete trunk cell list at trunk seq (rewind by inversion)."""
+        state = list(self.trunk_state)
+        for c in reversed(self.trunk):
+            if c.seq <= seq:
+                break
+            state = M.apply(state, M.invert(c.trunk_change))
+        return state
+
+    def _advance_branch(self, b: _Branch, to: int) -> None:
+        """Mirror the session's own processing of trunk commits in
+        (base, to]: own acks pop the chain head (view unchanged; exact
+        resync when the chain empties); concurrent commits apply their
+        id-operations to the mirrored view."""
+        for t in self.trunk:
+            if not (b.base < t.seq <= to):
+                continue
+            if b.chain_seqs and b.chain_seqs[0] == t.seq:
+                b.chain.pop(0)
+                b.chain_seqs.pop(0)
+                if not b.chain:
+                    b.state = self._state_at(t.seq)
+            else:
+                b.state = apply_ops_to_view(
+                    b.state, t.deleted_ids, t.runs, t.order_after
+                )
+        b.base = max(b.base, to)
+
+    def _transport(self, commit: Commit, pre: List[Cell]) -> TrunkCommit:
+        """Decode a commit authored on view ``pre`` into id-operations and
+        its positional trunk form (the id-anchor transport)."""
+        post = M.apply(pre, commit.change)
+
+        deleted_ids: Set[int] = set()
+        raw_runs: List[Tuple[int, List[Cell]]] = []  # (start in post, cells)
+        i_out = 0
+        for t, v in commit.change:
+            if t == "skip":
+                i_out += v
+            elif t == "del":
+                deleted_ids.update(cid for cid, _ in v)
+            else:
+                raw_runs.append((i_out, [tuple(c) for c in v]))
+                i_out += len(v)
+
+        trunk_ids = {cid for cid, _ in self.trunk_state}
+        out: List[Cell] = [
+            c for c in self.trunk_state if c[0] not in deleted_ids
+        ]
+        placed: Set[int] = set()
+        runs: List[Run] = []
+        for start, cells in raw_runs:
+            anchor = None
+            j = start - 1
+            while j >= 0:
+                cid = post[j][0]
+                if (cid in trunk_ids and cid not in deleted_ids) or cid in placed:
+                    anchor = cid
+                    break
+                j -= 1
+            runs.append((anchor, cells))
+            if anchor is None:
+                out[0:0] = cells
+            else:
+                pos = next(k + 1 for k, c in enumerate(out) if c[0] == anchor)
+                out[pos:pos] = cells
+            placed.update(cid for cid, _ in cells)
+
+        return TrunkCommit(
+            session=commit.session,
+            seq=commit.seq,
+            ref=commit.ref,
+            wire=commit.change,
+            trunk_change=_diff_cells(self.trunk_state, out, deleted_ids),
+            deleted_ids=deleted_ids,
+            runs=runs,
+            order_after=[c[0] for c in out],
+        )
+
+
+def _diff_cells(
+    old: List[Cell], new: List[Cell], deleted_ids: Set[int]
+) -> M.Changeset:
+    """Positional changeset old -> new (new = old minus deletions plus
+    inserted runs of ids not present in old)."""
+    old_ids = {c[0] for c in old}
+    change: M.Changeset = []
+    oi = 0
+    for cell in new:
+        if cell[0] in old_ids:
+            while oi < len(old) and old[oi][0] != cell[0]:
+                assert old[oi][0] in deleted_ids, "cell reorder in diff"
+                change.append(M.delete([old[oi]]))
+                oi += 1
+            change.append(M.skip(1))
+            oi += 1
+        else:
+            change.append(M.insert([cell]))
+    while oi < len(old):
+        change.append(M.delete([old[oi]]))
+        oi += 1
+    return M.normalize(change)

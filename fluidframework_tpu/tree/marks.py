@@ -1,0 +1,243 @@
+"""Sequence changesets as flat run-length mark lists.
+
+Reference: SharedTree's sequence-field kernel
+(``packages/dds/tree/src/feature-libraries/sequence-field/{format,rebase,
+compose,invert}.ts`` — SURVEY.md Appendix B.3): a changeset over a sequence
+is a run-length list of marks co-iterated against another list with marks
+split to equal lengths. This flat form is the vectorizable IR (run arrays,
+prefix-sum alignment); the host implementation here is the semantic core
+the device kernel mirrors.
+
+Mark forms (tuples):
+- ``("skip", n)`` — keep n input items.
+- ``("del", [values])`` — remove these input items (values carried so
+  inversion can revive them, the reference's detached-content analog).
+- ``("ins", [values])`` — insert items at this point.
+
+A changeset's *input length* is the sum of its skip/del runs; it applies to
+any sequence of at least that length (a trailing implicit skip covers the
+rest). ``compose``/``invert``/``rebase`` form the group-like algebra of the
+reference's ChangeRebaser contract (``core/rebase/rebaser.ts:105-121``),
+property-checked in ``tests/test_tree_marks.py``.
+
+Insert tie policy: when two changesets insert at the same position, the
+*later-sequenced* insert ends up closer to the position (before the earlier
+one) — consistent with the merge-tree kernel's breakTie ordering.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+Mark = Tuple[str, Any]
+Changeset = List[Mark]
+
+
+def skip(n: int) -> Mark:
+    return ("skip", n)
+
+
+def delete(values: list) -> Mark:
+    return ("del", list(values))
+
+
+def insert(values: list) -> Mark:
+    return ("ins", list(values))
+
+
+def mark_len(m: Mark) -> int:
+    """Input-length of a mark (inserts consume no input)."""
+    if m[0] == "skip":
+        return m[1]
+    if m[0] == "del":
+        return len(m[1])
+    return 0
+
+
+def input_len(c: Changeset) -> int:
+    return sum(mark_len(m) for m in c)
+
+
+def output_len_delta(c: Changeset) -> int:
+    d = 0
+    for t, v in c:
+        if t == "ins":
+            d += len(v)
+        elif t == "del":
+            d -= len(v)
+    return d
+
+
+def normalize(c: Changeset) -> Changeset:
+    """Merge adjacent same-type runs, drop empties and trailing skips."""
+    out: Changeset = []
+    for t, v in c:
+        if t == "skip" and v == 0:
+            continue
+        if t in ("del", "ins") and not v:
+            continue
+        if out and out[-1][0] == t:
+            if t == "skip":
+                out[-1] = ("skip", out[-1][1] + v)
+            else:
+                out[-1] = (t, out[-1][1] + list(v))
+        else:
+            out.append((t, v if t == "skip" else list(v)))
+    while out and out[-1][0] == "skip":
+        out.pop()
+    return out
+
+
+def apply(state: list, c: Changeset) -> list:
+    """Apply a changeset to a concrete sequence."""
+    out: list = []
+    i = 0
+    for t, v in c:
+        if t == "skip":
+            out.extend(state[i : i + v])
+            i += v
+        elif t == "del":
+            assert state[i : i + len(v)] == list(v), (
+                f"delete mismatch at {i}: {state[i:i+len(v)]} != {v}"
+            )
+            i += len(v)
+        else:
+            out.extend(v)
+    out.extend(state[i:])
+    return out
+
+
+def invert(c: Changeset) -> Changeset:
+    """Inverse changeset (over c's output document)."""
+    out: Changeset = []
+    for t, v in c:
+        if t == "skip":
+            out.append(("skip", v))
+        elif t == "del":
+            out.append(("ins", list(v)))
+        else:
+            out.append(("del", list(v)))
+    return normalize(out)
+
+
+class _Reader:
+    """Run reader with head splitting (the reference's MarkQueue)."""
+
+    def __init__(self, marks: Changeset):
+        self.q = [(t, v if t == "skip" else list(v)) for t, v in marks]
+
+    def done(self) -> bool:
+        return not self.q
+
+    def head(self) -> Mark:
+        return self.q[0]
+
+    def pop(self) -> Mark:
+        return self.q.pop(0)
+
+    def take(self, n: int) -> Mark:
+        """Take up to n input-units from the head run (must not be an ins)."""
+        t, v = self.q[0]
+        ln = mark_len((t, v))
+        assert ln > 0
+        if n >= ln:
+            return self.q.pop(0)
+        if t == "skip":
+            self.q[0] = ("skip", v - n)
+            return ("skip", n)
+        self.q[0] = ("del", v[n:])
+        return ("del", v[:n])
+
+
+def compose_all(changes: List[Changeset]) -> Changeset:
+    out: Changeset = []
+    for c in changes:
+        out = compose(out, c)
+    return out
+
+
+def compose(a: Changeset, b: Changeset) -> Changeset:
+    """Changeset equivalent to applying ``a`` then ``b``.
+
+    ``b`` reads a's output; the result reads a's input.
+    """
+    out: Changeset = []
+    ar = _Reader(a)
+    br = _Reader(b)
+    while not br.done():
+        bt, bv = br.head()
+        if bt == "ins":
+            out.append(br.pop())
+            continue
+        n = mark_len((bt, bv))
+        # Pull n units of a-output to cover b's mark.
+        taken = 0
+        while taken < n:
+            if ar.done():
+                # a's implicit trailing skip.
+                rest = br.take(n - taken)
+                out.append(rest)
+                taken = n
+                break
+            at, av = ar.head()
+            if at == "del":
+                out.append(ar.pop())  # invisible to b; passes through
+                continue
+            if at == "ins":
+                m = min(len(av), n - taken)
+                piece = av[:m]
+                if m == len(av):
+                    ar.pop()
+                else:
+                    ar.q[0] = ("ins", av[m:])
+                bm = br.take(m)
+                if bm[0] == "skip":
+                    out.append(("ins", piece))  # survives
+                # else b deleted a's insert: cancels, emit nothing
+                taken += m
+            else:  # a skip
+                m = min(av, n - taken)
+                ar.take(m)
+                out.append(br.take(m))
+                taken += m
+    while not ar.done():
+        out.append(ar.pop() if ar.head()[0] != "ins" else ar.pop())
+    return normalize(out)
+
+
+def rebase(c: Changeset, over: Changeset, c_after: bool = False) -> Changeset:
+    """Rebase ``c`` over concurrent ``over`` (both read the same input).
+
+    ``c_after=False`` (default): ``c`` is the later-sequenced change, so at
+    insert ties c's insert lands *before* over's insert (merge-tree
+    ordering). The EditManager always rebases later changes over earlier
+    ones, so the default applies there; ``c_after=True`` gives the mirror
+    policy, used by axiom checks.
+    """
+    out: Changeset = []
+    cr = _Reader(c)
+    orr = _Reader(over)
+    while not cr.done():
+        ct, cv = cr.head()
+        if ct == "ins":
+            if c_after and not orr.done() and orr.head()[0] == "ins":
+                out.append(("skip", len(orr.pop()[1])))
+            out.append(cr.pop())
+            continue
+        if orr.done():
+            out.append(cr.pop())
+            continue
+        ot, ov = orr.head()
+        if ot == "ins":
+            out.append(("skip", len(ov)))  # over's new content: step across
+            orr.pop()
+            continue
+        n = min(mark_len((ct, cv)), mark_len((ot, ov)))
+        cm = cr.take(n)
+        om = orr.take(n)
+        if om[0] == "skip":
+            out.append(cm)
+        # om is del: that input is gone; c's skip/del over it vanishes.
+    # over's trailing inserts after c's input end with no more c marks: c's
+    # implicit trailing skip covers them — nothing to emit.
+    return normalize(out)

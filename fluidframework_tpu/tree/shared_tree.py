@@ -1,0 +1,135 @@
+"""SharedTree — rebase-merged collaborative sequence DDS.
+
+Reference: ``packages/dds/tree`` (``shared-tree-core/sharedTreeCore.ts``,
+``shared-tree/sharedTree.ts``): unlike the merge-tree family, SharedTree
+merges by *rebasing changesets* through an EditManager. Round 1 exposes the
+root sequence field (a collaborative list) over the full trunk/branch
+machinery; hierarchical fields (modular-schema) layer on in later rounds.
+
+Items are cells ``(id, value)`` — ids allocated per author (the
+id-compressor analog: ``session_slot * 2^20 + counter``). Local edits author
+positional changesets against the current view; remote commits transport
+through the EditManager's id-anchor rebase. Resubmission after reconnect
+re-sends the local view chain, which is kept rebased onto the trunk tip —
+rebased content, not stale coordinates, goes back on the wire.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from fluidframework_tpu.protocol.types import SequencedDocumentMessage
+from fluidframework_tpu.runtime.shared_object import SharedObject
+from fluidframework_tpu.tree import marks as M
+from fluidframework_tpu.tree.edit_manager import Commit, EditManager
+
+_ID_STRIDE = 1 << 20
+
+
+class SharedTree(SharedObject):
+    def __init__(self, channel_id: str):
+        super().__init__(channel_id)
+        self._em: Optional[EditManager] = None
+        self._counter = 0
+
+    def attach(self, runtime) -> None:
+        super().attach(runtime)
+        self._em = EditManager(self.client_id)
+
+    def on_reconnect(self, new_client_id: int) -> None:
+        self._em.set_session(new_client_id)
+
+    # -- reads ----------------------------------------------------------------
+
+    def get(self) -> list:
+        return [v for _i, v in self._em.local_view()]
+
+    def __len__(self) -> int:
+        return len(self._em.local_view())
+
+    # -- local edits ----------------------------------------------------------
+
+    def _fresh_cells(self, values: list) -> list:
+        cells = []
+        for v in values:
+            self._counter += 1
+            cells.append((self.client_id * _ID_STRIDE + self._counter, v))
+        return cells
+
+    def _author(self, change: M.Changeset) -> None:
+        change = M.normalize(change)
+        self._em.add_local(change)
+        self.submit_local_message({"marks": change})
+
+    def insert_nodes(self, index: int, values: list) -> None:
+        assert values
+        view = self._em.local_view()
+        assert 0 <= index <= len(view), f"insert index {index} out of range"
+        self._author([M.skip(index), M.insert(self._fresh_cells(values))])
+
+    def delete_nodes(self, index: int, count: int = 1) -> None:
+        view = self._em.local_view()
+        assert 0 <= index and index + count <= len(view)
+        self._author([M.skip(index), M.delete(view[index : index + count])])
+
+    # -- sequenced stream -----------------------------------------------------
+
+    def process_core(
+        self, msg: SequencedDocumentMessage, local: bool, local_metadata: Optional[Any]
+    ) -> None:
+        marks = [
+            (t, v if t == "skip" else [tuple(c) for c in v])
+            for t, v in msg.contents["marks"]
+        ]
+        self._em.add_sequenced(
+            Commit(
+                session=msg.client_id,
+                seq=msg.sequence_number,
+                ref=msg.reference_sequence_number,
+                change=marks,
+            )
+        )
+        self._em.advance_min_seq(msg.minimum_sequence_number)
+
+    # -- resubmit: squash the pending delta against the current trunk ---------
+
+    def begin_resubmit(self) -> None:
+        self._squashed = False
+
+    def resubmit_core(self, contents: Any, local_metadata: Any) -> None:
+        """All pending local edits resubmit as one squashed changeset: the
+        id-diff of the local view against the trunk tip (both concrete, so
+        the rebased positions are exact by construction)."""
+        if self._squashed:
+            return
+        self._squashed = True
+        from fluidframework_tpu.tree.edit_manager import _diff_cells
+
+        trunk = self._em.trunk_state
+        view = self._em.local_view()
+        view_ids = {c[0] for c in view}
+        deleted = {c[0] for c in trunk if c[0] not in view_ids}
+        change = _diff_cells(trunk, view, deleted)
+        if change:
+            self._em.reset_inflight(1)
+            self.submit_local_message({"marks": change})
+        else:
+            self._em.reset_inflight(0)
+
+    def end_resubmit(self) -> None:
+        self._squashed = False
+
+    # -- summary / load -------------------------------------------------------
+
+    def summarize_core(self) -> dict:
+        assert self._em.inflight == 0, "summarize with pending local edits"
+        return {
+            "cells": [[i, v] for i, v in self._em.trunk_state],
+            "seq": self._em.trunk_seq,
+        }
+
+    def load_core(self, summary: dict) -> None:
+        self._em = EditManager(self.client_id)
+        self._em.trunk_state = [(int(i), v) for i, v in summary["cells"]]
+        self._em.view_state = list(self._em.trunk_state)
+        self._em.trunk_seq = summary["seq"]

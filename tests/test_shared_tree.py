@@ -1,0 +1,139 @@
+"""SharedTree end-to-end: EditManager rebase convergence over the real
+service + runtime stack (reference editManager.ts semantics)."""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service.local_server import LocalFluidService
+from fluidframework_tpu.tree import SharedTree
+
+
+def setup(n=2):
+    svc = LocalFluidService()
+    return svc, [
+        ContainerRuntime(svc, "doc", channels=(SharedTree("t"),))
+        for _ in range(n)
+    ]
+
+
+def drain(rts):
+    busy = True
+    while busy:
+        busy = any(rt.process_incoming() for rt in rts if rt.connected)
+
+
+def test_basic_insert_delete():
+    svc, (a, b) = setup()
+    ta, tb = a.get_channel("t"), b.get_channel("t")
+    ta.insert_nodes(0, [1, 2, 3])
+    drain([a, b])
+    assert tb.get() == [1, 2, 3]
+    tb.delete_nodes(1)
+    drain([a, b])
+    assert ta.get() == tb.get() == [1, 3]
+
+
+def test_concurrent_inserts_rebase():
+    svc, (a, b) = setup()
+    ta, tb = a.get_channel("t"), b.get_channel("t")
+    ta.insert_nodes(0, [100])
+    drain([a, b])
+    ta.insert_nodes(1, [1])  # both append at index 1 concurrently
+    tb.insert_nodes(1, [2])
+    a.flush()
+    b.flush()
+    drain([a, b])
+    assert ta.get() == tb.get()
+    assert set(ta.get()) == {100, 1, 2}
+
+
+def test_concurrent_delete_insert():
+    svc, (a, b) = setup()
+    ta, tb = a.get_channel("t"), b.get_channel("t")
+    ta.insert_nodes(0, [1, 2, 3, 4])
+    drain([a, b])
+    ta.delete_nodes(1, 2)  # delete [2, 3]
+    tb.insert_nodes(2, [9])  # insert between 2 and 3
+    a.flush()
+    b.flush()
+    drain([a, b])
+    assert ta.get() == tb.get() == [1, 9, 4]
+
+
+def test_concurrent_overlapping_deletes():
+    svc, (a, b) = setup()
+    ta, tb = a.get_channel("t"), b.get_channel("t")
+    ta.insert_nodes(0, [1, 2, 3, 4, 5])
+    drain([a, b])
+    ta.delete_nodes(0, 3)  # [1,2,3]
+    tb.delete_nodes(2, 2)  # [3,4]
+    a.flush()
+    b.flush()
+    drain([a, b])
+    assert ta.get() == tb.get() == [5]
+
+
+def test_chain_of_unacked_edits():
+    svc, (a, b) = setup()
+    ta, tb = a.get_channel("t"), b.get_channel("t")
+    ta.insert_nodes(0, [1])
+    ta.insert_nodes(1, [2])
+    ta.delete_nodes(0)
+    ta.insert_nodes(0, [3])  # all four unflushed, chained
+    tb.insert_nodes(0, [50])
+    a.flush()
+    b.flush()
+    drain([a, b])
+    assert ta.get() == tb.get()
+    assert set(ta.get()) == {2, 3, 50}
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_tree_farm(seed):
+    rng = np.random.default_rng(seed + 7000)
+    n = 3
+    svc, rts = setup(n)
+    trees = [rt.get_channel("t") for rt in rts]
+    trees[0].insert_nodes(0, [0])
+    drain(rts)
+    next_val = [1]
+
+    for _ in range(100):
+        i = int(rng.integers(0, n))
+        rt, t = rts[i], trees[i]
+        act = rng.integers(0, 4)
+        length = len(t)
+        if act == 0:
+            k = int(rng.integers(1, 3))
+            t.insert_nodes(
+                int(rng.integers(0, length + 1)),
+                list(range(next_val[0], next_val[0] + k)),
+            )
+            next_val[0] += k
+        elif act == 1 and length > 0:
+            idx = int(rng.integers(0, length))
+            t.delete_nodes(idx, int(rng.integers(1, min(3, length - idx) + 1)))
+        elif act == 2:
+            rt.flush()
+        else:
+            rt.process_incoming(int(rng.integers(1, 5)))
+
+    drain(rts)
+    states = [t.get() for t in trees]
+    assert states[0] == states[1] == states[2], f"diverged: {states}"
+
+
+def test_tree_reconnect():
+    svc, (a, b) = setup()
+    ta, tb = a.get_channel("t"), b.get_channel("t")
+    ta.insert_nodes(0, [1, 2, 3])
+    drain([a, b])
+    a.disconnect()
+    ta.insert_nodes(3, [4])
+    ta.delete_nodes(0)
+    tb.insert_nodes(0, [99])
+    drain([b])
+    a.reconnect()
+    drain([a, b])
+    assert ta.get() == tb.get() == [99, 2, 3, 4]

@@ -1,0 +1,134 @@
+"""Changeset algebra law checks — the verifyChangeRebaser analog
+(reference ``tree/src/core/rebase/verifyChangeRebaser.ts``)."""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.tree import marks as M
+
+
+def random_state(rng, n=None):
+    n = int(rng.integers(0, 9)) if n is None else n
+    return [int(x) for x in rng.integers(100, 999, n)]
+
+
+def random_change(rng, state):
+    """A valid changeset over `state` (mix of skips, deletes, inserts)."""
+    out = []
+    i = 0
+    while i < len(state):
+        r = rng.random()
+        run = int(rng.integers(1, 4))
+        run = min(run, len(state) - i)
+        if r < 0.4:
+            out.append(M.skip(run))
+            i += run
+        elif r < 0.7:
+            out.append(M.delete(state[i : i + run]))
+            i += run
+        else:
+            out.append(M.insert(random_state(rng, int(rng.integers(1, 3)))))
+    if rng.random() < 0.5:
+        out.append(M.insert(random_state(rng, int(rng.integers(1, 3)))))
+    return M.normalize(out)
+
+
+def test_apply_basics():
+    s = [1, 2, 3, 4]
+    c = [M.skip(1), M.delete([2, 3]), M.insert([9])]
+    assert M.apply(s, c) == [1, 9, 4]
+
+
+def test_invert_roundtrip_directed():
+    s = [1, 2, 3]
+    c = [M.skip(1), M.delete([2]), M.insert([7, 8])]
+    out = M.apply(s, c)
+    assert M.apply(out, M.invert(c)) == s
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_invert_roundtrip_fuzz(seed):
+    rng = np.random.default_rng(seed)
+    s = random_state(rng)
+    c = random_change(rng, s)
+    out = M.apply(s, c)
+    assert M.apply(out, M.invert(c)) == s
+    # Double inversion is identity up to normalization.
+    assert M.normalize(M.invert(M.invert(c))) == M.normalize(c)
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_compose_matches_sequential_apply(seed):
+    rng = np.random.default_rng(seed + 1000)
+    s = random_state(rng)
+    a = random_change(rng, s)
+    mid = M.apply(s, a)
+    b = random_change(rng, mid)
+    assert M.apply(s, M.compose(a, b)) == M.apply(mid, b)
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_compose_associative(seed):
+    rng = np.random.default_rng(seed + 2000)
+    s = random_state(rng)
+    a = random_change(rng, s)
+    s1 = M.apply(s, a)
+    b = random_change(rng, s1)
+    s2 = M.apply(s1, b)
+    c = random_change(rng, s2)
+    left = M.compose(M.compose(a, b), c)
+    right = M.compose(a, M.compose(b, c))
+    assert M.apply(s, left) == M.apply(s, right)
+
+
+def test_compose_identity():
+    rng = np.random.default_rng(7)
+    s = random_state(rng)
+    c = random_change(rng, s)
+    assert M.apply(s, M.compose([], c)) == M.apply(s, c)
+    assert M.apply(s, M.compose(c, [])) == M.apply(s, c)
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_rebase_convergence_pairwise(seed):
+    """The core two-client law: applying a then rebase(b, a) equals
+    applying b then rebase(a, b) with the mirrored tie policy."""
+    rng = np.random.default_rng(seed + 3000)
+    s = random_state(rng)
+    a = random_change(rng, s)
+    b = random_change(rng, s)
+    via_a = M.apply(M.apply(s, a), M.rebase(b, a))
+    via_b = M.apply(M.apply(s, b), M.rebase(a, b, c_after=True))
+    assert via_a == via_b
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_rebase_over_inverse_returns(seed):
+    """rebase(rebase(c, o), invert(o)) ≍ c when o deletes nothing that c
+    touches (the reference's axiom, restricted like verifyChangeRebaser's
+    tolerance for content lost under deletion)."""
+    rng = np.random.default_rng(seed + 4000)
+    s = random_state(rng)
+    # o: insert-only change (no information loss).
+    o = M.normalize(
+        [M.skip(int(rng.integers(0, len(s) + 1))), M.insert(random_state(rng, 2))]
+    )
+    c = random_change(rng, s)
+    back = M.rebase(M.rebase(c, o), M.invert(o))
+    assert M.apply(s, back) == M.apply(s, c)
+
+
+def test_rebase_insert_tie_later_lands_left():
+    s = [1, 2]
+    a = [M.skip(1), M.insert([10])]  # earlier-sequenced
+    b = [M.skip(1), M.insert([20])]  # later-sequenced
+    merged = M.apply(M.apply(s, a), M.rebase(b, a))
+    assert merged == [1, 20, 10, 2]
+
+
+def test_rebase_insert_inside_deleted_range_slides():
+    s = [1, 2, 3, 4]
+    o = [M.skip(1), M.delete([2, 3])]  # deletes the middle
+    c = [M.skip(2), M.insert([9])]  # insert between 2 and 3
+    out = M.apply(M.apply(s, o), M.rebase(c, o))
+    assert out == [1, 9, 4]
