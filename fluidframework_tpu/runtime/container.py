@@ -63,8 +63,13 @@ class ContainerRuntime:
         self.pending_proposals: Dict[int, tuple] = {}
         self.approved_proposals: Dict[str, Any] = {}
         self.on_op: Optional[Callable[[SequencedDocumentMessage], None]] = None
+        # Summary tracking (reference SummaryCollection / RunningSummarizer).
+        self.last_summary_seq = 0
+        self.summary_interval: Optional[int] = None  # auto-summarize period
         for ch in channels:
             self.create_channel(ch)
+        if self.connection.initial_summary is not None:
+            self._load_summary(self.connection.initial_summary)
         self.process_incoming()  # catch up to head
 
     # -- channels -------------------------------------------------------------
@@ -201,7 +206,12 @@ class ContainerRuntime:
                     local,
                     local_metadata,
                 )
+        if msg.type == MessageType.SUMMARY_ACK:
+            self.last_summary_seq = max(
+                self.last_summary_seq, msg.contents["head"]
+            )
         self._check_proposals()
+        self._maybe_auto_summarize()
         if self.on_op is not None:
             self.on_op(msg)
 
@@ -272,12 +282,70 @@ class ContainerRuntime:
                 key, value = self.pending_proposals.pop(seq)
                 self.approved_proposals[key] = value
 
-    # -- summaries (round-1 minimal: full snapshot, no incremental handles) ---
+    # -- summaries (§3.4: summarize -> upload -> Summarize op -> scribe ack) --
 
     def summarize(self) -> dict:
+        """Full summary: channel trees + protocol state (quorum, proposals)
+        — the ``.protocol`` tree of the reference's client summary."""
         return {
             "sequence_number": self.ref_seq,
+            "quorum": sorted(self.quorum_members),
+            "proposals": {
+                str(seq): list(kv) for seq, kv in self.pending_proposals.items()
+            },
+            "approved": dict(self.approved_proposals),
             "channels": {
                 cid: ch.summarize_core() for cid, ch in self.channels.items()
             },
         }
+
+    def _load_summary(self, initial: tuple) -> None:
+        handle, seq = initial
+        summary = self._service.store.get_summary(handle)
+        assert summary["sequence_number"] == seq
+        for cid, channel_summary in summary["channels"].items():
+            if cid in self.channels:
+                self.channels[cid].load_core(channel_summary)
+        self.quorum_members = {c: {"client_id": c} for c in summary["quorum"]}
+        self.pending_proposals = {
+            int(seq_key): tuple(kv)
+            for seq_key, kv in summary["proposals"].items()
+        }
+        self.approved_proposals = dict(summary["approved"])
+        self.ref_seq = seq
+        self.last_summary_seq = seq
+
+    def submit_summary(self) -> str:
+        """Upload the current summary and submit the Summarize op; the
+        scribe acks or nacks it on the sequenced stream."""
+        assert not self.pending and not self._outbox, (
+            "summarize with unacked local ops"
+        )
+        summary = self.summarize()
+        handle = self._service.store.put_summary(summary)
+        self.client_seq += 1
+        self.connection.submit(
+            DocumentMessage(
+                client_sequence_number=self.client_seq,
+                reference_sequence_number=self.ref_seq,
+                type=MessageType.SUMMARIZE,
+                contents={"handle": handle, "head": self.ref_seq},
+            )
+        )
+        return handle
+
+    @property
+    def is_summarizer(self) -> bool:
+        """Oldest eligible quorum member is elected (the reference's
+        orderedClientElection: earliest-joined client wins)."""
+        return bool(self.quorum_members) and min(self.quorum_members) == self.client_id
+
+    def _maybe_auto_summarize(self) -> None:
+        if (
+            self.summary_interval is not None
+            and self.is_summarizer
+            and not self.pending
+            and not self._outbox
+            and self.ref_seq - self.last_summary_seq >= self.summary_interval
+        ):
+            self.submit_summary()

@@ -15,11 +15,13 @@ from typing import Callable, Dict, List, Optional
 
 from fluidframework_tpu.protocol.types import (
     DocumentMessage,
+    MessageType,
     NackMessage,
     SequencedDocumentMessage,
     SignalMessage,
 )
 from fluidframework_tpu.service.sequencer import DocumentSequencer
+from fluidframework_tpu.service.summary_store import SummaryStore
 
 
 @dataclass
@@ -33,6 +35,9 @@ class LocalConnection:
     signals: List[SignalMessage] = field(default_factory=list)
     nacks: List[NackMessage] = field(default_factory=list)
     on_nack: Optional[Callable[[NackMessage], None]] = None
+    # Latest acked summary at connect time: (handle, seq) or None — the
+    # client loads it, then catches up from seq (reference storage.getVersions).
+    initial_summary: Optional[tuple] = None
 
     def submit(self, msg: DocumentMessage) -> None:
         self.service.submit(self.doc_id, self.client_id, msg)
@@ -56,13 +61,20 @@ class _DocState:
         self.op_log: List[SequencedDocumentMessage] = []  # scriptorium
         self.connections: Dict[int, LocalConnection] = {}
         self.signal_counter = 0
+        # Scribe state (reference scribe/lambda.ts): the latest acked
+        # client summary and the protocol head it advanced to.
+        self.latest_summary: Optional[tuple] = None  # (handle, seq)
+        self.protocol_head = 0
 
 
 class LocalFluidService:
-    """In-proc service endpoint: connect/submit/broadcast + durable op log."""
+    """In-proc service endpoint: connect/submit/broadcast + durable op log
+    + summary storage (ordering, scriptorium, broadcaster, and scribe roles
+    of the reference pipeline, in one process)."""
 
-    def __init__(self) -> None:
+    def __init__(self, store: Optional[SummaryStore] = None) -> None:
         self.docs: Dict[str, _DocState] = {}
+        self.store = store or SummaryStore()
 
     def _doc(self, doc_id: str) -> _DocState:
         if doc_id not in self.docs:
@@ -80,10 +92,12 @@ class LocalFluidService:
             raise ConnectionError(res.message)
         client_id = res.contents
         conn = LocalConnection(doc_id=doc_id, client_id=client_id, service=self)
-        # Catch-up: the connection receives the historical op stream after
-        # ``from_seq`` (reconnecting clients resume where they left off; a
-        # fresh client replays everything — the driver-storage fetch path),
-        # then live ops including its own join.
+        # Catch-up: a fresh client gets the latest acked summary plus the op
+        # tail after it; a reconnecting client resumes from where it left
+        # off (reference storage.getVersions + delta fetch).
+        if from_seq == 0 and doc.latest_summary is not None:
+            conn.initial_summary = doc.latest_summary
+            from_seq = doc.latest_summary[1]
         conn.inbox.extend(
             m for m in doc.op_log if m.sequence_number > from_seq
         )
@@ -113,6 +127,31 @@ class LocalFluidService:
                     conn.on_nack(res)
             return
         self._broadcast(doc, res)
+        if res.type == MessageType.SUMMARIZE:
+            self._scribe(doc, res)
+
+    def _scribe(self, doc: _DocState, msg: SequencedDocumentMessage) -> None:
+        """Validate a sequenced Summarize op and ack/nack it (reference
+        scribe/lambda.ts:204-240: refSeq must not precede the protocol head
+        and the uploaded tree must exist)."""
+        handle = msg.contents["handle"]
+        head = msg.contents["head"]
+        ok = (
+            msg.reference_sequence_number >= doc.protocol_head
+            and self.store.has(handle)
+        )
+        if ok:
+            doc.latest_summary = (handle, head)
+            doc.protocol_head = msg.sequence_number
+        ack = doc.sequencer._sequence_system(
+            MessageType.SUMMARY_ACK if ok else MessageType.SUMMARY_NACK,
+            contents={
+                "handle": handle,
+                "summary_seq": msg.sequence_number,
+                "head": head,
+            },
+        )
+        self._broadcast(doc, ack)
 
     def submit_signal(self, doc_id: str, client_id: int, content) -> None:
         doc = self._doc(doc_id)

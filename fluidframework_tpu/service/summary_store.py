@@ -1,0 +1,74 @@
+"""Content-addressed summary storage.
+
+Reference: summaries are git trees written through historian/gitrest
+(``server/gitrest``, libgit2-backed; ``scribe/summaryWriter.ts``). Here the
+same content-addressed model: blobs keyed by digest, trees mapping names to
+child handles, incremental reuse for free (unchanged subtrees hash to the
+same handle). The Python interface is backed either by an in-memory dict or
+by the native C++ store (``native/``), selected at construction.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, Optional
+
+
+class SummaryStore:
+    """In-memory content-addressed store (the TestHistorian analog)."""
+
+    def __init__(self) -> None:
+        self._blobs: Dict[str, bytes] = {}
+
+    # -- blobs ----------------------------------------------------------------
+
+    def put_blob(self, data: bytes) -> str:
+        h = hashlib.sha256(data).hexdigest()
+        self._blobs[h] = data
+        return h
+
+    def get_blob(self, handle: str) -> bytes:
+        return self._blobs[handle]
+
+    def has(self, handle: str) -> bool:
+        return handle in self._blobs
+
+    # -- trees (JSON-encoded name -> handle maps) -----------------------------
+
+    def put_tree(self, entries: Dict[str, str]) -> str:
+        data = json.dumps(entries, sort_keys=True).encode()
+        return self.put_blob(b"tree:" + data)
+
+    def get_tree(self, handle: str) -> Dict[str, str]:
+        data = self.get_blob(handle)
+        assert data.startswith(b"tree:"), "handle is not a tree"
+        return json.loads(data[5:])
+
+    # -- whole summaries ------------------------------------------------------
+
+    def put_summary(self, summary: dict) -> str:
+        """Store a runtime summary as one tree of per-channel blobs (the
+        shredded-summary layout: unchanged channels re-hash identically)."""
+        entries = {}
+        for cid, channel_summary in summary["channels"].items():
+            entries["channel:" + cid] = self.put_blob(
+                json.dumps(channel_summary, sort_keys=True).encode()
+            )
+        entries["meta"] = self.put_blob(
+            json.dumps(
+                {k: v for k, v in summary.items() if k != "channels"},
+                sort_keys=True,
+            ).encode()
+        )
+        return self.put_tree(entries)
+
+    def get_summary(self, handle: str) -> dict:
+        entries = self.get_tree(handle)
+        out = json.loads(self.get_blob(entries["meta"]))
+        out["channels"] = {
+            name[len("channel:"):]: json.loads(self.get_blob(h))
+            for name, h in entries.items()
+            if name.startswith("channel:")
+        }
+        return out

@@ -1,0 +1,133 @@
+"""Summary flow tests: summarize -> upload -> scribe ack -> load-from-summary
+(SURVEY §3.4/§3.5, Appendix C.4)."""
+
+from fluidframework_tpu.models.shared_map import SharedMap
+from fluidframework_tpu.models.shared_string import SharedString
+from fluidframework_tpu.protocol.types import MessageType
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service.local_server import LocalFluidService
+from fluidframework_tpu.service.summary_store import SummaryStore
+from fluidframework_tpu.tree import SharedTree
+
+
+def drain(rts):
+    busy = True
+    while busy:
+        busy = any(rt.process_incoming() for rt in rts if rt.connected)
+
+
+def channels():
+    return (SharedString("text"), SharedMap("meta"), SharedTree("list"))
+
+
+def test_store_content_addressing():
+    s = SummaryStore()
+    h1 = s.put_blob(b"hello")
+    h2 = s.put_blob(b"hello")
+    assert h1 == h2  # incremental reuse: identical content, identical handle
+    t = s.put_tree({"a": h1})
+    assert s.get_tree(t) == {"a": h1}
+
+
+def test_summary_ack_and_protocol_head():
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "doc", channels=channels())
+    a.get_channel("text").insert_text(0, "hello")
+    a.get_channel("meta").set("k", 1)
+    drain([a])
+    handle = a.submit_summary()
+    drain([a])
+    doc = svc.docs["doc"]
+    assert doc.latest_summary is not None and doc.latest_summary[0] == handle
+    assert doc.protocol_head > 0
+    assert a.last_summary_seq == doc.latest_summary[1]
+
+
+def test_load_from_summary():
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "doc", channels=channels())
+    a.get_channel("text").insert_text(0, "persisted state")
+    a.get_channel("meta").set("title", "doc")
+    a.get_channel("list").insert_nodes(0, [1, 2, 3])
+    drain([a])
+    a.submit_summary()
+    drain([a])
+    # More ops after the summary: the new client loads + catches up.
+    a.get_channel("text").insert_text(0, ">> ")
+    drain([a])
+
+    b = ContainerRuntime(svc, "doc", channels=channels())
+    assert b.get_channel("text").get_text() == ">> persisted state"
+    assert b.get_channel("meta").get("title") == "doc"
+    assert b.get_channel("list").get() == [1, 2, 3]
+    # And the late joiner keeps collaborating normally.
+    b.get_channel("text").remove_range(0, 3)
+    drain([a, b])
+    assert a.get_channel("text").get_text() == "persisted state"
+
+
+def test_stale_summary_nacked():
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "doc", channels=channels())
+    b = ContainerRuntime(svc, "doc", channels=channels())
+    a.get_channel("text").insert_text(0, "x")
+    drain([a, b])
+    a.submit_summary()
+    drain([a, b])
+    head = svc.docs["doc"].protocol_head
+    # Forge a summarize op with a stale refSeq (below protocol head).
+    from fluidframework_tpu.protocol.types import DocumentMessage
+
+    handle = svc.store.put_summary(b.summarize())
+    stale_ref = svc.docs["doc"].sequencer.min_seq  # passes deli, trails scribe
+    assert stale_ref < head
+    b.client_seq += 1
+    b.connection.submit(
+        DocumentMessage(
+            client_sequence_number=b.client_seq,
+            reference_sequence_number=stale_ref,
+            type=MessageType.SUMMARIZE,
+            contents={"handle": handle, "head": stale_ref},
+        )
+    )
+    nacks = [
+        m
+        for m in svc.docs["doc"].op_log
+        if m.type == MessageType.SUMMARY_NACK
+    ]
+    assert nacks, "stale summary should be nacked"
+    assert svc.docs["doc"].protocol_head == head  # unchanged
+
+
+def test_summarizer_election_and_auto_summary():
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "doc", channels=channels())
+    b = ContainerRuntime(svc, "doc", channels=channels())
+    a.summary_interval = 5
+    b.summary_interval = 5
+    assert a.is_summarizer and not b.is_summarizer  # oldest member wins
+    for i in range(8):
+        b.get_channel("meta").set(f"k{i}", i)
+        drain([a, b])
+    assert svc.docs["doc"].latest_summary is not None
+    # Election moves when the oldest client leaves.
+    a.disconnect()
+    drain([b])
+    assert b.is_summarizer
+
+
+def test_incremental_reuse_across_summaries():
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "doc", channels=channels())
+    a.get_channel("text").insert_text(0, "stable")
+    a.get_channel("meta").set("k", 1)
+    drain([a])
+    h1 = a.submit_summary()
+    drain([a])
+    a.get_channel("meta").set("k", 2)  # only the map changes
+    drain([a])
+    h2 = a.submit_summary()
+    drain([a])
+    t1, t2 = svc.store.get_tree(h1), svc.store.get_tree(h2)
+    assert t1["channel:text"] == t2["channel:text"]  # unchanged -> same handle
+    assert t1["channel:meta"] != t2["channel:meta"]
