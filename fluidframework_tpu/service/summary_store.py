@@ -15,13 +15,9 @@ import json
 from typing import Dict, Optional
 
 
-class SummaryStore:
-    """In-memory content-addressed store (the TestHistorian analog)."""
-
+class _DictBackend:
     def __init__(self) -> None:
         self._blobs: Dict[str, bytes] = {}
-
-    # -- blobs ----------------------------------------------------------------
 
     def put_blob(self, data: bytes) -> str:
         h = hashlib.sha256(data).hexdigest()
@@ -33,6 +29,31 @@ class SummaryStore:
 
     def has(self, handle: str) -> bool:
         return handle in self._blobs
+
+
+class SummaryStore:
+    """Content-addressed store over a pluggable blob backend: the native
+    C++ store (``native/ca_store.cpp``, optionally disk-persistent) when
+    available, else an in-memory dict (the TestHistorian analog). Both key
+    blobs by SHA-256, so handles are interchangeable."""
+
+    def __init__(self, backend=None, native: bool = False, directory=None):
+        if backend is None and native:
+            from fluidframework_tpu.utils.native import NativeBlobStore
+
+            backend = NativeBlobStore(directory)
+        self._backend = backend or _DictBackend()
+
+    # -- blobs ----------------------------------------------------------------
+
+    def put_blob(self, data: bytes) -> str:
+        return self._backend.put_blob(data)
+
+    def get_blob(self, handle: str) -> bytes:
+        return self._backend.get_blob(handle)
+
+    def has(self, handle: str) -> bool:
+        return self._backend.has(handle)
 
     # -- trees (JSON-encoded name -> handle maps) -----------------------------
 

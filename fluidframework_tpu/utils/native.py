@@ -1,0 +1,107 @@
+"""ctypes bindings for the native (C++) runtime components.
+
+The compute path is JAX/XLA; the storage/runtime path uses C++ where the
+reference used native dependencies (SURVEY §2.9: libgit2-backed git storage
+-> ``native/ca_store.cpp``). Libraries build on demand with ``make`` and
+load via ctypes; callers fall back to pure-Python equivalents when the
+toolchain is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+
+_castore_lib = None
+_castore_tried = False
+
+
+def _load_castore() -> Optional[ctypes.CDLL]:
+    global _castore_lib, _castore_tried
+    if _castore_tried:
+        return _castore_lib
+    _castore_tried = True
+    so = os.path.join(_NATIVE_DIR, "libcastore.so")
+    if not os.path.exists(so):
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except (subprocess.SubprocessError, OSError):
+            return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    lib.castore_new.restype = ctypes.c_void_p
+    lib.castore_new.argtypes = [ctypes.c_char_p]
+    lib.castore_free.argtypes = [ctypes.c_void_p]
+    lib.castore_put.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+        ctypes.c_char_p,
+    ]
+    lib.castore_size.restype = ctypes.c_int64
+    lib.castore_size.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.castore_get.restype = ctypes.c_int64
+    lib.castore_get.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+    ]
+    lib.castore_has.restype = ctypes.c_int
+    lib.castore_has.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    _castore_lib = lib
+    return lib
+
+
+class NativeBlobStore:
+    """C++ content-addressed blob store (raises if the library is
+    unavailable — use :func:`native_store_available` to probe)."""
+
+    def __init__(self, directory: Optional[str] = None):
+        lib = _load_castore()
+        if lib is None:
+            raise RuntimeError("libcastore.so unavailable")
+        self._lib = lib
+        self._h = lib.castore_new(
+            directory.encode() if directory else None
+        )
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.castore_free(self._h)
+            self._h = None
+
+    def put_blob(self, data: bytes) -> str:
+        out = ctypes.create_string_buffer(65)
+        self._lib.castore_put(self._h, data, len(data), out)
+        return out.value.decode()
+
+    def get_blob(self, handle: str) -> bytes:
+        n = self._lib.castore_size(self._h, handle.encode())
+        if n < 0:
+            raise KeyError(handle)
+        buf = ctypes.create_string_buffer(max(int(n), 1))
+        got = self._lib.castore_get(self._h, handle.encode(), buf, n)
+        assert got == n
+        return buf.raw[:n]
+
+    def has(self, handle: str) -> bool:
+        return bool(self._lib.castore_has(self._h, handle.encode()))
+
+
+def native_store_available() -> bool:
+    return _load_castore() is not None

@@ -1,0 +1,223 @@
+// Content-addressed blob store — native backend for summary storage.
+//
+// The TPU framework's equivalent of the reference's git object storage
+// (server/gitrest, libgit2 via nodegit): blobs are keyed by their SHA-256
+// digest, held in memory and optionally persisted to a directory layout of
+// the usual fan-out form (dir/ab/<hex>). Exposed as a C ABI consumed from
+// Python via ctypes (fluidframework_tpu/utils/native.py).
+//
+// Build: make -C native   (produces libcastore.so)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// SHA-256 (self-contained; FIPS 180-4)
+// ---------------------------------------------------------------------------
+
+struct Sha256 {
+  uint32_t h[8];
+  uint64_t len = 0;
+  uint8_t buf[64];
+  size_t buflen = 0;
+
+  Sha256() {
+    static const uint32_t init[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                     0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                     0x1f83d9ab, 0x5be0cd19};
+    memcpy(h, init, sizeof(h));
+  }
+
+  static uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+  void block(const uint8_t *p) {
+    static const uint32_t k[64] = {
+        0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+        0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+        0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+        0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+        0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+        0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+        0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+        0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+        0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+        0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+        0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+        0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+        0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+      w[i] = (uint32_t(p[i * 4]) << 24) | (uint32_t(p[i * 4 + 1]) << 16) |
+             (uint32_t(p[i * 4 + 2]) << 8) | uint32_t(p[i * 4 + 3]);
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + k[i] + w[i];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t mj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + mj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const uint8_t *p, size_t n) {
+    len += n;
+    while (n > 0) {
+      size_t take = 64 - buflen;
+      if (take > n) take = n;
+      memcpy(buf + buflen, p, take);
+      buflen += take;
+      p += take;
+      n -= take;
+      if (buflen == 64) {
+        block(buf);
+        buflen = 0;
+      }
+    }
+  }
+
+  void final_hex(char out[65]) {
+    uint64_t bits = len * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t zero = 0;
+    while (buflen != 56) update(&zero, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; i++) lenb[i] = uint8_t(bits >> (56 - 8 * i));
+    update(lenb, 8);
+    static const char *hex = "0123456789abcdef";
+    for (int i = 0; i < 8; i++)
+      for (int j = 0; j < 4; j++) {
+        uint8_t byte = uint8_t(h[i] >> (24 - 8 * j));
+        out[i * 8 + j * 2] = hex[byte >> 4];
+        out[i * 8 + j * 2 + 1] = hex[byte & 0xf];
+      }
+    out[64] = 0;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Store
+// ---------------------------------------------------------------------------
+
+struct Store {
+  std::unordered_map<std::string, std::vector<uint8_t>> blobs;
+  std::string dir;  // empty = memory only
+  std::mutex mu;
+
+  std::string path_for(const std::string &hash) const {
+    return dir + "/" + hash.substr(0, 2) + "/" + hash.substr(2);
+  }
+
+  bool load_from_disk(const std::string &hash, std::vector<uint8_t> &out) {
+    if (dir.empty()) return false;
+    FILE *f = fopen(path_for(hash).c_str(), "rb");
+    if (!f) return false;
+    fseek(f, 0, SEEK_END);
+    long n = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    out.resize(size_t(n));
+    size_t got = n > 0 ? fread(out.data(), 1, size_t(n), f) : 0;
+    fclose(f);
+    return got == size_t(n);
+  }
+
+  void persist(const std::string &hash, const std::vector<uint8_t> &data) {
+    if (dir.empty()) return;
+    mkdir(dir.c_str(), 0755);
+    std::string sub = dir + "/" + hash.substr(0, 2);
+    mkdir(sub.c_str(), 0755);
+    std::string tmp = path_for(hash) + ".tmp";
+    FILE *f = fopen(tmp.c_str(), "wb");
+    if (!f) return;
+    fwrite(data.data(), 1, data.size(), f);
+    fclose(f);
+    rename(tmp.c_str(), path_for(hash).c_str());
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void *castore_new(const char *dir) {
+  auto *s = new Store();
+  if (dir && dir[0]) s->dir = dir;
+  return s;
+}
+
+void castore_free(void *h) { delete static_cast<Store *>(h); }
+
+// Stores the blob and writes its 64-char hex digest (+NUL) to out_hash.
+void castore_put(void *h, const uint8_t *data, size_t n, char *out_hash) {
+  auto *s = static_cast<Store *>(h);
+  Sha256 sha;
+  sha.update(data, n);
+  char hex[65];
+  sha.final_hex(hex);
+  std::string key(hex);
+  {
+    std::lock_guard<std::mutex> lock(s->mu);
+    if (!s->blobs.count(key)) {
+      std::vector<uint8_t> v(data, data + n);
+      s->persist(key, v);
+      s->blobs.emplace(key, std::move(v));
+    }
+  }
+  memcpy(out_hash, hex, 65);
+}
+
+// Returns the blob size, or -1 if absent.
+int64_t castore_size(void *h, const char *hash) {
+  auto *s = static_cast<Store *>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto it = s->blobs.find(hash);
+  if (it != s->blobs.end()) return int64_t(it->second.size());
+  std::vector<uint8_t> v;
+  if (s->load_from_disk(hash, v)) {
+    int64_t n = int64_t(v.size());
+    s->blobs.emplace(hash, std::move(v));
+    return n;
+  }
+  return -1;
+}
+
+// Copies the blob into buf (must be at least castore_size bytes).
+// Returns bytes written, or -1 if absent.
+int64_t castore_get(void *h, const char *hash, uint8_t *buf, size_t buflen) {
+  auto *s = static_cast<Store *>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto it = s->blobs.find(hash);
+  if (it == s->blobs.end()) {
+    std::vector<uint8_t> v;
+    if (!s->load_from_disk(hash, v)) return -1;
+    it = s->blobs.emplace(hash, std::move(v)).first;
+  }
+  size_t n = it->second.size();
+  if (buflen < n) return -1;
+  memcpy(buf, it->second.data(), n);
+  return int64_t(n);
+}
+
+int castore_has(void *h, const char *hash) {
+  return castore_size(h, hash) >= 0 ? 1 : 0;
+}
+}
