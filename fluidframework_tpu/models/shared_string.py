@@ -49,6 +49,8 @@ class SharedString(SharedObject):
         self._state = None  # created on attach (needs client slot)
         self._payloads: dict = {}
         self._lseq = 0
+        self._interval_collections: dict = {}
+        self._local_refs: list = []
 
     def attach(self, runtime) -> None:
         super().attach(runtime)
@@ -82,6 +84,64 @@ class SharedString(SharedObject):
     @property
     def err_flags(self) -> int:
         return int(to_host(self._state).err)
+
+    def _host_view(self):
+        return to_host(self._state)
+
+    # -- local references / interval collections ------------------------------
+
+    def create_local_reference(self, pos: int, bias: str = "fwd"):
+        """A position reference that survives concurrent edits and slides on
+        acked remove (reference ``localReference.ts:142``). Resolve with
+        ``ref.position(string._host_view())``."""
+        from fluidframework_tpu.models.interval_collection import (
+            LocalReference,
+            anchor_from_pos,
+        )
+
+        ref = LocalReference(anchor_from_pos(self._host_view(), pos), bias=bias)
+        self._local_refs.append(ref)
+        return ref
+
+    def ref_position(self, ref) -> int:
+        return ref.position(self._host_view())
+
+    def get_interval_collection(self, label: str):
+        """Named interval collection (reference
+        ``sequence.ts getIntervalCollection``), created lazily."""
+        from fluidframework_tpu.models.interval_collection import (
+            IntervalCollection,
+        )
+
+        col = self._interval_collections.get(label)
+        if col is None:
+            col = self._interval_collections[label] = IntervalCollection(
+                label, self
+            )
+        return col
+
+    def _submit_interval_op(self, label: str, body: dict) -> None:
+        self.submit_local_message(
+            {"k": "ic", "label": label, "body": body},
+            {"kind": "ic", "label": label, "body": body},
+        )
+
+    def remove_local_reference(self, ref) -> None:
+        try:
+            self._local_refs.remove(ref)
+        except ValueError:
+            pass
+
+    def _normalize_refs(self) -> None:
+        if not (self._interval_collections or self._local_refs):
+            return
+        h = self._host_view()
+        for col in self._interval_collections.values():
+            col.normalize_all(h)
+        for ref in self._local_refs:
+            ref.normalize(h)
+        # Detached references never resolve again; stop paying for them.
+        self._local_refs = [r for r in self._local_refs if not r.detached]
 
     # -- local edits ----------------------------------------------------------
 
@@ -133,6 +193,16 @@ class SharedString(SharedObject):
         local: bool,
         local_metadata: Optional[Any],
     ) -> None:
+        if local and local_metadata["kind"] == "ic":
+            self.get_interval_collection(local_metadata["label"]).process(
+                local_metadata["body"], msg, local=True
+            )
+            return
+        if not local and msg.contents.get("k") == "ic":
+            self.get_interval_collection(msg.contents["label"]).process(
+                msg.contents["body"], msg, local=False
+            )
+            return
         if local:
             row = E.ack(
                 local_metadata["kind"],
@@ -143,6 +213,14 @@ class SharedString(SharedObject):
         else:
             row = self._row_from_contents(msg)
         self._apply(row)
+        # Slide references eagerly once a removal is sequenced (A.9): the
+        # remove just applied is acked, so anchors on it re-anchor before
+        # compaction can reclaim the row.
+        is_remove = (local and local_metadata["kind"] == "remove") or (
+            not local and msg.contents["k"] == "rem"
+        )
+        if is_remove:
+            self._normalize_refs()
 
     def _row_from_contents(self, msg: SequencedDocumentMessage) -> np.ndarray:
         c = msg.contents
@@ -169,6 +247,9 @@ class SharedString(SharedObject):
         # convergent regardless of when each one compacts.
         cap = capacity_of(self._state)
         if int(to_host(self._state).count) > cap - 8:
+            # References must slide off acked-removed rows before compaction
+            # reclaims them (A.9 eager slide).
+            self._normalize_refs()
             self._state = compact(self._state)
             if int(to_host(self._state).count) > cap - 8:
                 self._state = grow(self._state, cap * 2)
@@ -202,7 +283,13 @@ class SharedString(SharedObject):
             regen_remove,
         )
 
-        kind, L = local_metadata["kind"], local_metadata["lseq"]
+        kind = local_metadata["kind"]
+        if kind == "ic":
+            self.get_interval_collection(local_metadata["label"]).resubmit(
+                local_metadata["body"]
+            )
+            return
+        L = local_metadata["lseq"]
         h = getattr(self, "_rebase_view", None) or to_host(self._state)
         if kind == "insert":
             runs = regen_insert(h, L)
@@ -262,6 +349,10 @@ class SharedString(SharedObject):
             "min_seq": int(h.min_seq),
             "cur_seq": int(h.cur_seq),
             "payloads": dict(self._payloads),
+            "intervals": {
+                label: col.summarize()
+                for label, col in sorted(self._interval_collections.items())
+            },
         }
 
     def load_core(self, summary: dict) -> None:
@@ -282,3 +373,5 @@ class SharedString(SharedObject):
             cur_seq=jnp.int32(summary["cur_seq"]),
         )
         self._payloads = {int(k): v for k, v in summary["payloads"].items()}
+        for label, entries in summary.get("intervals", {}).items():
+            self.get_interval_collection(label).load(entries)

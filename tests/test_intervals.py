@@ -1,0 +1,210 @@
+"""Interval collections + local references (reference intervalCollection.ts,
+localReference.ts; SURVEY.md A.9): position stability under concurrent
+edits, slide-on-remove, multi-client convergence, reconnect rebase, and
+summary round-trip."""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.models.interval_collection import DETACHED
+from fluidframework_tpu.models.shared_string import SharedString
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service.local_server import LocalFluidService
+
+
+def make_pair(n=2):
+    svc = LocalFluidService()
+    rts = [
+        ContainerRuntime(svc, "doc", channels=(SharedString("text"),))
+        for _ in range(n)
+    ]
+    return svc, rts, [rt.get_channel("text") for rt in rts]
+
+
+def drain(rts):
+    for rt in rts:
+        rt.flush()
+    while any(rt.process_incoming() for rt in rts):
+        pass
+
+
+def test_reference_shifts_with_inserts_and_removes():
+    svc, (a,), (sa,) = (lambda s, r, c: (s, r, c))(*make_pair(1))
+    sa.insert_text(0, "hello world")
+    drain([a])
+    ref = sa.create_local_reference(6)  # the 'w'
+    assert sa.ref_position(ref) == 6
+
+    sa.insert_text(0, ">> ")
+    drain([a])
+    assert sa.ref_position(ref) == 9
+
+    sa.remove_range(0, 3)
+    drain([a])
+    assert sa.ref_position(ref) == 6
+
+
+def test_reference_slides_forward_on_acked_remove():
+    svc, (a,), (sa,) = (lambda s, r, c: (s, r, c))(*make_pair(1))
+    sa.insert_text(0, "abcdef")
+    drain([a])
+    ref = sa.create_local_reference(2)  # 'c'
+    sa.remove_range(1, 4)  # removes bcd; ref should slide fwd to 'e'
+    drain([a])
+    assert sa.get_text() == "aef"
+    assert sa.ref_position(ref) == 1  # 'e'
+
+
+def test_reference_slides_backward_at_document_end():
+    svc, (a,), (sa,) = (lambda s, r, c: (s, r, c))(*make_pair(1))
+    sa.insert_text(0, "abc")
+    drain([a])
+    ref = sa.create_local_reference(2, bias="bwd")
+    sa.remove_range(1, 3)
+    drain([a])
+    assert sa.get_text() == "a"
+    assert sa.ref_position(ref) == 0
+
+
+def test_reference_detaches_when_document_emptied():
+    svc, (a,), (sa,) = (lambda s, r, c: (s, r, c))(*make_pair(1))
+    sa.insert_text(0, "xyz")
+    drain([a])
+    ref = sa.create_local_reference(1)
+    sa.remove_range(0, 3)
+    drain([a])
+    assert sa.ref_position(ref) == DETACHED
+
+
+def test_interval_add_and_resolve_two_clients():
+    svc, rts, (sa, sb) = make_pair()
+    sa.insert_text(0, "the quick brown fox")
+    drain(rts)
+
+    ca = sa.get_interval_collection("comments")
+    iid = ca.add(4, 8, props={"author": "a"})  # "quick"
+    drain(rts)
+
+    cb = sb.get_interval_collection("comments")
+    assert cb.resolve(iid) == (4, 8)
+    assert cb.get(iid).props == {"author": "a"}
+
+    # Remote insert before the interval shifts it on both replicas.
+    sb.insert_text(0, ">>> ")
+    drain(rts)
+    assert ca.resolve(iid) == (8, 12)
+    assert cb.resolve(iid) == (8, 12)
+
+
+def test_interval_endpoints_resolved_at_sender_perspective():
+    svc, rts, (sa, sb) = make_pair()
+    sa.insert_text(0, "abcdefgh")
+    drain(rts)
+
+    # B adds an interval over "cde" while A concurrently prepends text the
+    # sender has not seen; the interval must still cover "cde" everywhere.
+    cb = sb.get_interval_collection("x")
+    iid = cb.add(2, 4)
+    sa.insert_text(0, "123")
+    drain(rts)
+
+    assert sa.get_text() == sb.get_text() == "123abcdefgh"
+    assert sa.get_interval_collection("x").resolve(iid) == (5, 7)
+    assert cb.resolve(iid) == (5, 7)
+
+
+def test_interval_slides_on_concurrent_remove():
+    svc, rts, (sa, sb) = make_pair()
+    sa.insert_text(0, "abcdefgh")
+    drain(rts)
+
+    ca = sa.get_interval_collection("x")
+    iid = ca.add(2, 5)  # "cdef"
+    drain(rts)
+
+    sb.remove_range(1, 4)  # removes bcd: start anchor 'c' gone
+    drain(rts)
+
+    assert sa.get_text() == "aefgh"
+    ra = sa.get_interval_collection("x").resolve(iid)
+    rb = sb.get_interval_collection("x").resolve(iid)
+    assert ra == rb == (1, 2)  # slid fwd to 'e', end still 'f'
+
+
+def test_interval_change_lww_and_local_pending_wins():
+    svc, rts, (sa, sb) = make_pair()
+    sa.insert_text(0, "abcdefgh")
+    drain(rts)
+    ca = sa.get_interval_collection("x")
+    cb = sb.get_interval_collection("x")
+    iid = ca.add(0, 1)
+    drain(rts)
+
+    # Concurrent changes: A moves to (2,3), B moves to (5,6). Both flush;
+    # the later-sequenced change wins on every replica.
+    ca.change(iid, start=2, end=3)
+    cb.change(iid, start=5, end=6)
+    rts[0].flush()
+    rts[1].flush()
+    drain(rts)
+    assert ca.resolve(iid) == cb.resolve(iid)
+
+
+def test_interval_delete_wins_everywhere():
+    svc, rts, (sa, sb) = make_pair()
+    sa.insert_text(0, "abcdefgh")
+    drain(rts)
+    ca = sa.get_interval_collection("x")
+    cb = sb.get_interval_collection("x")
+    iid = ca.add(0, 3)
+    drain(rts)
+
+    cb.delete(iid)
+    ca.change(iid, start=1, end=2)  # concurrent change loses to delete
+    drain(rts)
+    assert ca.get(iid) is None
+    assert cb.get(iid) is None
+
+
+def test_interval_reconnect_resubmits_pending_add():
+    svc, rts, (sa, sb) = make_pair()
+    sa.insert_text(0, "abcdefgh")
+    drain(rts)
+
+    rts[0].disconnect()
+    ca = sa.get_interval_collection("x")
+    iid = ca.add(2, 4)
+    # B edits while A is offline.
+    sb.insert_text(0, "ZZ")
+    rts[1].flush()
+    drain([rts[1]])
+
+    rts[0].reconnect()
+    drain(rts)
+    assert sa.get_text() == sb.get_text() == "ZZabcdefgh"
+    assert sa.get_interval_collection("x").resolve(iid) == (4, 6)
+    assert sb.get_interval_collection("x").resolve(iid) == (4, 6)
+
+
+def test_interval_summary_round_trip():
+    svc, rts, (sa, sb) = make_pair()
+    sa.insert_text(0, "hello world")
+    ca = sa.get_interval_collection("marks")
+    iid = ca.add(6, 10, props={"tag": "w"})
+    drain(rts)
+
+    summary = sa.summarize_core()
+    fresh = SharedString("text")
+
+    class _FakeRuntime:
+        client_id = 7
+
+        def submit_channel_op(self, *a, **k):  # pragma: no cover
+            raise AssertionError("no ops during load")
+
+    fresh.attach(_FakeRuntime())
+    fresh.load_core(summary)
+    assert fresh.get_text() == "hello world"
+    col = fresh.get_interval_collection("marks")
+    assert col.resolve(iid) == (6, 10)
+    assert col.get(iid).props == {"tag": "w"}
