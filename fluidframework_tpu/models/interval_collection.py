@@ -166,14 +166,36 @@ class LocalReference:
 
 @dataclass
 class Interval:
-    """One named range: inclusive ``[start, end]`` char positions."""
+    """One named range: inclusive ``[start, end]`` char positions.
+
+    The local-wins overlay is per field (reference intervalCollection
+    pendingChange* maps): a pending local start move only shields *start*
+    from remote changes — concurrent disjoint-field edits still merge.
+    """
 
     id: str
     start: LocalReference
     end: LocalReference
     props: Dict[str, Any] = field(default_factory=dict)
     last_seq: int = 0  # seq of the last applied sequenced change (LWW)
-    pending: int = 0  # count of unacked local changes (local-wins overlay)
+    pending_start: int = 0  # unacked local start moves
+    pending_end: int = 0  # unacked local end moves
+    pending_props: Dict[str, int] = field(default_factory=dict)
+
+    def ack_fields(self, body: dict) -> None:
+        """Decrement the overlay for the fields one acked/dropped local op
+        carried (an ``add`` carries all of them)."""
+        whole = body["a"] == "add"
+        if whole or body.get("s") is not None:
+            self.pending_start = max(0, self.pending_start - 1)
+        if whole or body.get("e") is not None:
+            self.pending_end = max(0, self.pending_end - 1)
+        for k in body.get("props") or {}:
+            n = self.pending_props.get(k, 0) - 1
+            if n > 0:
+                self.pending_props[k] = n
+            else:
+                self.pending_props.pop(k, None)
 
 
 class IntervalCollection:
@@ -232,7 +254,9 @@ class IntervalCollection:
             start=LocalReference(anchor_from_pos(h, start), bias="fwd"),
             end=LocalReference(anchor_from_pos(h, end), bias="bwd"),
             props=dict(props or {}),
-            pending=1,
+            pending_start=1,
+            pending_end=1,
+            pending_props={k: 1 for k in (props or {})},
         )
         self._intervals[iid] = iv
         self._submit({"a": "add", "id": iid, "s": start, "e": end,
@@ -263,7 +287,12 @@ class IntervalCollection:
         if props:
             iv.props.update(props)
             iv.props = {k: v for k, v in iv.props.items() if v is not None}
-        iv.pending += 1
+            for k in props:
+                iv.pending_props[k] = iv.pending_props.get(k, 0) + 1
+        if start is not None:
+            iv.pending_start += 1
+        if end is not None:
+            iv.pending_end += 1
         self._submit({"a": "chg", "id": interval_id, "s": start, "e": end,
                       "props": props or {}})
 
@@ -277,7 +306,7 @@ class IntervalCollection:
         if local:
             iv = self._intervals.get(iid)
             if iv is not None:
-                iv.pending = max(0, iv.pending - 1)
+                iv.ack_fields(body)
                 iv.last_seq = msg.sequence_number
             return
         if iid in self._tombstones:
@@ -302,23 +331,28 @@ class IntervalCollection:
             self._tombstones.add(iid)
         elif body["a"] == "chg":
             iv = self._intervals.get(iid)
-            if iv is None or iv.pending > 0:
-                return  # unknown id, or local-pending overlay wins
+            if iv is None:
+                return
             if msg.sequence_number <= iv.last_seq:
                 return  # stale (defensive; the stream is totally ordered)
-            if body.get("s") is not None:
+            # Per-field local-wins: a pending local move of one endpoint
+            # shields only that endpoint; same per prop key.
+            if body.get("s") is not None and iv.pending_start == 0:
                 iv.start = LocalReference(
                     anchor_from_pos(h, body["s"], **per), bias="fwd"
                 )
                 iv.start.normalize(h)
-            if body.get("e") is not None:
+            if body.get("e") is not None and iv.pending_end == 0:
                 iv.end = LocalReference(
                     anchor_from_pos(h, body["e"], **per), bias="bwd"
                 )
                 iv.end.normalize(h)
-            if body.get("props"):
-                iv.props.update(body["props"])
-                iv.props = {k: v for k, v in iv.props.items() if v is not None}
+            for k, v in (body.get("props") or {}).items():
+                if iv.pending_props.get(k, 0) == 0:
+                    if v is None:
+                        iv.props.pop(k, None)
+                    else:
+                        iv.props[k] = v
             iv.last_seq = msg.sequence_number
         else:  # pragma: no cover
             raise ValueError(f"unknown interval op {body!r}")
@@ -348,12 +382,17 @@ class IntervalCollection:
             # against current state. Drop it and unwind the optimistic local
             # apply so this replica matches the others (no ghost interval,
             # no permanently-stuck pending overlay).
-            iv.pending = max(0, iv.pending - 1)
+            iv.ack_fields(body)
             if body["a"] == "add":
                 self._intervals.pop(iid, None)
             return
         out = {"a": body["a"], "id": iid, "s": s, "e": e,
                "props": body.get("props") or {}}
+        if body["a"] == "chg":
+            # Preserve which fields the original op carried so the ack
+            # decrements exactly the overlay entries the submit incremented.
+            out["s"] = s if body.get("s") is not None else None
+            out["e"] = e if body.get("e") is not None else None
         self._submit(out)
 
     # -- summary -------------------------------------------------------------

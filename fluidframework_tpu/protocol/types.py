@@ -64,6 +64,7 @@ class SequencedDocumentMessage:
     minimum_sequence_number: int
     type: MessageType
     contents: Any = None
+    metadata: Optional[dict] = None
     timestamp: float = 0.0
     traces: list = field(default_factory=list)
 

@@ -20,6 +20,12 @@ from fluidframework_tpu.protocol.types import (
     MessageType,
     SequencedDocumentMessage,
 )
+from fluidframework_tpu.runtime.op_lifecycle import (
+    DEFAULT_CHUNK_SIZE,
+    DEFAULT_COMPRESSION_THRESHOLD,
+    RemoteMessageProcessor,
+    pack_batch,
+)
 from fluidframework_tpu.runtime.shared_object import SharedObject
 from fluidframework_tpu.service.local_server import LocalFluidService
 
@@ -33,6 +39,8 @@ class ContainerRuntime:
         doc_id: str,
         channels: tuple = (),
         mode: str = "write",
+        compression_threshold: Optional[int] = DEFAULT_COMPRESSION_THRESHOLD,
+        chunk_size: Optional[int] = DEFAULT_CHUNK_SIZE,
     ):
         """Connect and catch up to head before becoming interactive
         (reference Container.load, container.ts:300: snapshot + delta replay
@@ -58,6 +66,10 @@ class ContainerRuntime:
         # reference PendingStateManager semantics.
         self.pending: deque = deque()
         self._outbox: list = []
+        self.compression_threshold = compression_threshold
+        self.chunk_size = chunk_size
+        self._rmp = RemoteMessageProcessor()
+        self._open_batch = False  # inbound batch in flight (ScheduleManager)
         self.quorum_members: Dict[int, dict] = {}
         # Quorum proposals: pending by seq; approved key -> value.
         self.pending_proposals: Dict[int, tuple] = {}
@@ -98,17 +110,31 @@ class ContainerRuntime:
         if not self.connected:
             self._offline.extend(batch)
             return
-        n = len(batch)
-        for i, (channel_id, contents, local_metadata) in enumerate(batch):
+        self._send_batch(batch)
+
+    def _send_batch(self, batch: list) -> None:
+        """Pack a logical batch through the outbox pipeline (compression /
+        chunking / batch marks, D.1) and submit the wire messages. Pending
+        entries record the wire clientSequenceNumber whose sequencing acks
+        each logical op."""
+        envelopes = [
+            {"address": channel_id, "contents": contents}
+            for channel_id, contents, _meta in batch
+        ]
+        for w in pack_batch(envelopes, self.compression_threshold, self.chunk_size):
             self.client_seq += 1
-            self.pending.append((self.client_seq, channel_id, contents, local_metadata))
+            if w.logical_index is not None:
+                channel_id, contents, local_metadata = batch[w.logical_index]
+                self.pending.append(
+                    (self.client_seq, channel_id, contents, local_metadata)
+                )
             self.connection.submit(
                 DocumentMessage(
                     client_sequence_number=self.client_seq,
                     reference_sequence_number=self.ref_seq,
                     type=MessageType.OPERATION,
-                    contents={"address": channel_id, "contents": contents},
-                    metadata={"batch": n > 1, "batchIndex": i, "batchCount": n},
+                    contents=w.contents,
+                    metadata=w.metadata,
                 )
             )
 
@@ -126,6 +152,15 @@ class ContainerRuntime:
         msgs = self.connection.take_inbox(n)
         for msg in msgs:
             self._process_one(msg)
+        # Batch atomicity (reference ScheduleManager/DeltaScheduler): never
+        # yield mid-batch — if the limit n landed inside a batch, keep
+        # draining until its batchEnd arrives.
+        while self._open_batch:
+            more = self.connection.take_inbox(1)
+            if not more:
+                break  # remainder not yet sequenced; nothing interleaves
+            msgs.extend(more)
+            self._process_one(more[0])
         # Nack recovery (reference: nack -> resubmit, §5.3): after a nack,
         # nothing from this connection sequences until we resend, so the
         # entire pending tail regenerates against the caught-up state.
@@ -148,19 +183,7 @@ class ContainerRuntime:
             for ch in self.channels.values():
                 ch.end_resubmit()
             batch, self._outbox = self._outbox, []
-            for i, (channel_id, contents, local_metadata) in enumerate(batch):
-                self.client_seq += 1
-                self.pending.append(
-                    (self.client_seq, channel_id, contents, local_metadata)
-                )
-                self.connection.submit(
-                    DocumentMessage(
-                        client_sequence_number=self.client_seq,
-                        reference_sequence_number=self.ref_seq,
-                        type=MessageType.OPERATION,
-                        contents={"address": channel_id, "contents": contents},
-                    )
-                )
+            self._send_batch(batch)
         return len(msgs)
 
     def _process_one(self, msg: SequencedDocumentMessage) -> None:
@@ -169,6 +192,15 @@ class ContainerRuntime:
         ), f"sequence gap: {self.ref_seq} -> {msg.sequence_number}"
         self.ref_seq = msg.sequence_number
         self.min_seq = max(self.min_seq, msg.minimum_sequence_number)
+        meta = msg.metadata or {}
+        if meta.get("batchBegin"):
+            self._open_batch = True
+        if meta.get("batchEnd"):
+            self._open_batch = False
+        unpacked = self._rmp.process(msg)
+        if unpacked is None:
+            return  # swallowed wire message (non-final chunk)
+        msg = unpacked
 
         if msg.type == MessageType.CLIENT_JOIN:
             self.quorum_members[msg.contents] = {"client_id": msg.contents}

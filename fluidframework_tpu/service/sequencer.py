@@ -145,6 +145,7 @@ class DocumentSequencer:
             minimum_sequence_number=self._compute_msn(),
             type=msg.type,
             contents=msg.contents,
+            metadata=msg.metadata,
             timestamp=time.time(),
             traces=list(msg.traces),
         )

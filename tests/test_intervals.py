@@ -208,3 +208,41 @@ def test_interval_summary_round_trip():
     col = fresh.get_interval_collection("marks")
     assert col.resolve(iid) == (6, 10)
     assert col.get(iid).props == {"tag": "w"}
+
+
+def test_concurrent_disjoint_field_changes_merge():
+    """A pending local start move shields only start: concurrent end/props
+    changes from another client still land (per-field overlay, reference
+    pendingChange tracking in intervalCollection.ts)."""
+    svc, (a, b), (sa, sb) = (lambda s, r, c: (s, r, c))(*make_pair(2))
+    sa.insert_text(0, "0123456789")
+    drain([a, b])
+    col_a = sa.get_interval_collection("c")
+    col_b = sb.get_interval_collection("c")
+    iid = col_a.add(0, 1)
+    drain([a, b])
+
+    # Concurrent: a moves start, b moves end and sets a prop.
+    col_a.change(iid, start=3)
+    col_b.change(iid, end=6, props={"bold": 1})
+    drain([a, b])
+
+    assert col_a.resolve(iid) == col_b.resolve(iid) == (3, 6)
+    assert col_a.get(iid).props == col_b.get(iid).props == {"bold": 1}
+
+
+def test_concurrent_same_field_latest_seq_wins():
+    svc, (a, b), (sa, sb) = (lambda s, r, c: (s, r, c))(*make_pair(2))
+    sa.insert_text(0, "0123456789")
+    drain([a, b])
+    col_a = sa.get_interval_collection("c")
+    col_b = sb.get_interval_collection("c")
+    iid = col_a.add(0, 9)
+    drain([a, b])
+
+    col_a.change(iid, start=2)
+    col_b.change(iid, start=5)
+    drain([a, b])
+    # Both replicas agree; the later-sequenced change holds the field.
+    assert col_a.resolve(iid) == col_b.resolve(iid)
+    assert col_a.resolve(iid)[0] in (2, 5)
