@@ -20,6 +20,8 @@ from fluidframework_tpu.protocol.types import (
     MessageType,
     SequencedDocumentMessage,
 )
+from fluidframework_tpu.runtime.gc import GarbageCollector, GCOptions, GCResult
+from fluidframework_tpu.runtime.handles import collect_handle_routes, encode_handle
 from fluidframework_tpu.runtime.op_lifecycle import (
     DEFAULT_CHUNK_SIZE,
     DEFAULT_COMPRESSION_THRESHOLD,
@@ -28,6 +30,10 @@ from fluidframework_tpu.runtime.op_lifecycle import (
 )
 from fluidframework_tpu.runtime.shared_object import SharedObject
 from fluidframework_tpu.service.local_server import LocalFluidService
+
+
+class TombstoneError(Exception):
+    """Access to a tombstoned (GC'd) object (garbageCollection.ts:415)."""
 
 
 class ContainerRuntime:
@@ -41,6 +47,7 @@ class ContainerRuntime:
         mode: str = "write",
         compression_threshold: Optional[int] = DEFAULT_COMPRESSION_THRESHOLD,
         chunk_size: Optional[int] = DEFAULT_CHUNK_SIZE,
+        gc_options: Optional[GCOptions] = None,
     ):
         """Connect and catch up to head before becoming interactive
         (reference Container.load, container.ts:300: snapshot + delta replay
@@ -78,6 +85,10 @@ class ContainerRuntime:
         # Summary tracking (reference SummaryCollection / RunningSummarizer).
         self.last_summary_seq = 0
         self.summary_interval: Optional[int] = None  # auto-summarize period
+        # GC (D.3): root channels are always reachable (aliased datastores);
+        # non-root ones live only while a handle somewhere references them.
+        self.gc = GarbageCollector(gc_options)
+        self._root_ids: set = set()
         for ch in channels:
             self.create_channel(ch)
         if self.connection.initial_summary is not None:
@@ -86,14 +97,27 @@ class ContainerRuntime:
 
     # -- channels -------------------------------------------------------------
 
-    def create_channel(self, channel: SharedObject) -> SharedObject:
+    def create_channel(self, channel: SharedObject, root: bool = True) -> SharedObject:
+        """Register a channel (or datastore). ``root=True`` marks it aliased
+        (always GC-reachable, reference processAliasMessage semantics);
+        ``root=False`` objects survive only while referenced by a handle."""
         assert channel.id not in self.channels, f"duplicate channel {channel.id}"
         channel.attach(self)
         self.channels[channel.id] = channel
+        if root:
+            self._root_ids.add(channel.id)
         return channel
 
     def get_channel(self, channel_id: str) -> SharedObject:
+        if self.gc.is_tombstoned(f"/{channel_id}"):
+            raise TombstoneError(f"/{channel_id} is tombstoned")
         return self.channels[channel_id]
+
+    def handle_for(self, channel_id: str, sub_id: Optional[str] = None) -> dict:
+        """Encoded handle referencing a channel (or a datastore child) —
+        storable inside any DDS value; what GC traces."""
+        route = f"/{channel_id}" if sub_id is None else f"/{channel_id}/{sub_id}"
+        return encode_handle(route)
 
     # -- outbound (submit -> outbox -> flush, D.1) ----------------------------
 
@@ -197,13 +221,25 @@ class ContainerRuntime:
             self._open_batch = True
         if meta.get("batchEnd"):
             self._open_batch = False
+        # Every sequenced message from this client consumed a server-side
+        # clientSequenceNumber slot — PROPOSE/NOOP/SUMMARIZE included — so
+        # nack recovery must never reuse a number at or below it.
+        if msg.client_id in self._my_ids:
+            self._last_acked_cseq = max(
+                self._last_acked_cseq, msg.client_sequence_number
+            )
         unpacked = self._rmp.process(msg)
         if unpacked is None:
             return  # swallowed wire message (non-final chunk)
         msg = unpacked
 
         if msg.type == MessageType.CLIENT_JOIN:
-            self.quorum_members[msg.contents] = {"client_id": msg.contents}
+            detail = msg.contents
+            cid = detail["clientId"]
+            self.quorum_members[cid] = {
+                "client_id": cid,
+                "mode": detail.get("mode", "write"),
+            }
         elif msg.type == MessageType.CLIENT_LEAVE:
             self.quorum_members.pop(msg.contents, None)
             for ch in self.channels.values():
@@ -228,7 +264,6 @@ class ContainerRuntime:
                     f"pending mismatch: {pseq} != {msg.client_sequence_number}"
                 )
                 assert pchan == address
-                self._last_acked_cseq = msg.client_sequence_number
             channel = self.channels.get(address)
             if channel is not None:
                 channel.process_core(
@@ -316,19 +351,55 @@ class ContainerRuntime:
 
     # -- summaries (§3.4: summarize -> upload -> Summarize op -> scribe ack) --
 
+    def run_gc(self, channel_summaries: Optional[dict] = None) -> GCResult:
+        """Mark pass over the handle-reference graph (collectGarbage,
+        garbageCollection.ts:1007): root channels seed reachability; every
+        handle inside a reachable object's state references its target."""
+        if channel_summaries is None:
+            channel_summaries = {
+                cid: ch.summarize_core() for cid, ch in self.channels.items()
+            }
+        from fluidframework_tpu.runtime.datastore import FluidDataStore
+
+        graph: Dict[str, list] = {}
+        for cid, ch in self.channels.items():
+            route = f"/{cid}"
+            summary = channel_summaries[cid]
+            if isinstance(ch, FluidDataStore):  # per-child nodes, no re-summarize
+                children = summary["channels"]
+                graph[route] = [f"{route}/{sub}" for sub in sorted(children)]
+                for sub, sub_summary in children.items():
+                    child_route = f"{route}/{sub}"
+                    # Child -> parent edge: a referenced child keeps its
+                    # datastore alive (a route implies all its ancestors).
+                    graph[child_route] = [route] + collect_handle_routes(sub_summary)
+            else:
+                graph[route] = collect_handle_routes(summary)
+        return self.gc.collect(graph, [f"/{cid}" for cid in sorted(self._root_ids)])
+
     def summarize(self) -> dict:
         """Full summary: channel trees + protocol state (quorum, proposals)
-        — the ``.protocol`` tree of the reference's client summary."""
+        — the ``.protocol`` tree of the reference's client summary — plus
+        the ``gc`` tree (unreferenced-node tracking, D.3). Swept routes are
+        excluded, so future loads never resurrect them."""
+        channel_summaries = {
+            cid: ch.summarize_core() for cid, ch in self.channels.items()
+        }
+        gc_result = self.run_gc(channel_summaries)
+        for route in gc_result.swept:
+            cid = route.lstrip("/").split("/", 1)[0]
+            channel_summaries.pop(cid, None)
         return {
             "sequence_number": self.ref_seq,
-            "quorum": sorted(self.quorum_members),
+            "quorum": [
+                self.quorum_members[cid] for cid in sorted(self.quorum_members)
+            ],
             "proposals": {
                 str(seq): list(kv) for seq, kv in self.pending_proposals.items()
             },
             "approved": dict(self.approved_proposals),
-            "channels": {
-                cid: ch.summarize_core() for cid, ch in self.channels.items()
-            },
+            "channels": channel_summaries,
+            "gc": self.gc.summarize(),
         }
 
     def _load_summary(self, initial: tuple) -> None:
@@ -338,12 +409,20 @@ class ContainerRuntime:
         for cid, channel_summary in summary["channels"].items():
             if cid in self.channels:
                 self.channels[cid].load_core(channel_summary)
-        self.quorum_members = {c: {"client_id": c} for c in summary["quorum"]}
+        # Full member details (mode included) — election must agree between
+        # live and summary-loaded replicas.
+        self.quorum_members = {
+            (c["client_id"] if isinstance(c, dict) else c): (
+                c if isinstance(c, dict) else {"client_id": c, "mode": "write"}
+            )
+            for c in summary["quorum"]
+        }
         self.pending_proposals = {
             int(seq_key): tuple(kv)
             for seq_key, kv in summary["proposals"].items()
         }
         self.approved_proposals = dict(summary["approved"])
+        self.gc.load(summary.get("gc", {}))
         self.ref_seq = seq
         self.last_summary_seq = seq
 
@@ -369,8 +448,10 @@ class ContainerRuntime:
     @property
     def is_summarizer(self) -> bool:
         """Oldest eligible quorum member is elected (the reference's
-        orderedClientElection: earliest-joined client wins)."""
-        return bool(self.quorum_members) and min(self.quorum_members) == self.client_id
+        orderedClientElection: earliest-joined write client wins)."""
+        from fluidframework_tpu.runtime.summarizer import SummarizerElection
+
+        return SummarizerElection(self).is_elected
 
     def _maybe_auto_summarize(self) -> None:
         if (

@@ -90,7 +90,7 @@ class LocalFluidService:
         res = doc.sequencer.join(mode)
         if isinstance(res, NackMessage):
             raise ConnectionError(res.message)
-        client_id = res.contents
+        client_id = res.contents["clientId"]
         conn = LocalConnection(doc_id=doc_id, client_id=client_id, service=self)
         # Catch-up: a fresh client gets the latest acked summary plus the op
         # tail after it; a reconnecting client resumes from where it left

@@ -83,7 +83,11 @@ class DocumentSequencer:
             )
         slot = self._next_slot
         self._next_slot += 1
-        msg = self._sequence_system(MessageType.CLIENT_JOIN, contents=slot)
+        # Join contents carry the client detail (reference ClientJoin op's
+        # IClient payload) — election needs the mode for eligibility.
+        msg = self._sequence_system(
+            MessageType.CLIENT_JOIN, contents={"clientId": slot, "mode": mode}
+        )
         # The new client's collab-window floor is the join op itself.
         self.clients[slot] = _ClientEntry(
             client_id=slot, ref_seq=msg.sequence_number, client_seq=0, mode=mode

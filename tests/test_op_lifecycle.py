@@ -108,7 +108,6 @@ class TestEndToEnd:
             for d in svc.get_deltas("doc")
             if d.type == MessageType.OPERATION and d.client_id == a.client_id
         ]
-        raw = svc._doc("doc").raw_ops if hasattr(svc._doc("doc"), "raw_ops") else None
         assert len(ops) == 10  # one seq number per logical op
 
     def test_chunked_large_op_converges(self):

@@ -21,15 +21,15 @@ def test_join_assigns_slots_and_sequences():
     s = DocumentSequencer("d")
     j0 = s.join()
     j1 = s.join()
-    assert j0.contents == 0 and j1.contents == 1
+    assert j0.contents["clientId"] == 0 and j1.contents["clientId"] == 1
     assert (j0.sequence_number, j1.sequence_number) == (1, 2)
     assert j0.type == MessageType.CLIENT_JOIN
 
 
 def test_sequence_and_msn():
     s = DocumentSequencer("d")
-    c0 = s.join().contents
-    c1 = s.join().contents
+    c0 = s.join().contents["clientId"]
+    c1 = s.join().contents["clientId"]
     m = s.ticket(c0, op(1, 2))
     assert m.sequence_number == 3
     # MSN = min refSeq over clients = min(2, join-time 2) = 2
@@ -41,7 +41,7 @@ def test_sequence_and_msn():
 
 def test_duplicate_dropped_and_gap_nacked():
     s = DocumentSequencer("d")
-    c = s.join().contents
+    c = s.join().contents["clientId"]
     assert s.ticket(c, op(1, 1)).sequence_number == 2
     assert s.ticket(c, op(1, 1)) is None  # duplicate
     nack = s.ticket(c, op(3, 1))  # gap: skipped cseq 2
@@ -50,8 +50,8 @@ def test_duplicate_dropped_and_gap_nacked():
 
 def test_stale_refseq_nacked():
     s = DocumentSequencer("d")
-    c0 = s.join().contents
-    c1 = s.join().contents
+    c0 = s.join().contents["clientId"]
+    c1 = s.join().contents["clientId"]
     s.ticket(c0, op(1, 2))
     s.ticket(c1, op(1, 3))
     # push MSN up: both clients advance
@@ -71,15 +71,15 @@ def test_unknown_client_nacked():
 
 def test_read_client_cannot_write():
     s = DocumentSequencer("d")
-    c = s.join(mode="read").contents
+    c = s.join(mode="read").contents["clientId"]
     nack = s.ticket(c, op(1, 0))
     assert isinstance(nack, NackMessage) and nack.content_code == 403
 
 
 def test_leave_advances_msn():
     s = DocumentSequencer("d")
-    c0 = s.join().contents
-    c1 = s.join().contents
+    c0 = s.join().contents["clientId"]
+    c1 = s.join().contents["clientId"]
     s.ticket(c0, op(1, 2))  # c0 refSeq 2, c1 refSeq 2 (join-time)
     s.ticket(c1, op(1, 4))  # c1 refSeq 4
     lv = s.leave(c0)
@@ -88,7 +88,7 @@ def test_leave_advances_msn():
 
 def test_no_clients_msn_is_seq():
     s = DocumentSequencer("d")
-    c = s.join().contents
+    c = s.join().contents["clientId"]
     s.ticket(c, op(1, 1))
     lv = s.leave(c)
     assert lv.minimum_sequence_number == lv.sequence_number
@@ -96,8 +96,8 @@ def test_no_clients_msn_is_seq():
 
 def test_noop_consumes_seq_and_updates_msn():
     s = DocumentSequencer("d")
-    c0 = s.join().contents
-    c1 = s.join().contents
+    c0 = s.join().contents["clientId"]
+    c1 = s.join().contents["clientId"]
     s.ticket(c0, op(1, 2))
     before = s.seq
     noop = s.ticket(c1, op(1, 3, ty=MessageType.NOOP))
@@ -108,7 +108,7 @@ def test_noop_consumes_seq_and_updates_msn():
 
 def test_msn_never_regresses():
     s = DocumentSequencer("d")
-    c0 = s.join().contents
+    c0 = s.join().contents["clientId"]
     s.ticket(c0, op(1, 1))
     lv_seq = s.min_seq
     s.join()  # new client joins with refSeq = current seq
@@ -117,7 +117,7 @@ def test_msn_never_regresses():
 
 def test_checkpoint_resume():
     s = DocumentSequencer("d")
-    c0 = s.join().contents
+    c0 = s.join().contents["clientId"]
     s.ticket(c0, op(1, 1))
     cp = s.checkpoint()
     s2 = DocumentSequencer("d", cp)

@@ -1,0 +1,127 @@
+"""Summarizer election, heuristics, and ack/nack retry.
+
+Reference: container-runtime summarizer stack (summaryManager.ts,
+orderedClientElection.ts, runningSummarizer.ts + summarizerHeuristics.ts,
+summaryCollection.ts — SURVEY.md §3.4, D.5).
+"""
+
+from fluidframework_tpu.models.shared_map import SharedMap
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.runtime.summarizer import (
+    RunningSummarizer,
+    SummarizerElection,
+    SummaryConfig,
+)
+from fluidframework_tpu.service.local_server import LocalFluidService
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def drain(rts):
+    for rt in rts:
+        rt.flush()
+    while any(rt.process_incoming() for rt in rts):
+        pass
+
+
+def make(n=2, **cfg):
+    svc = LocalFluidService()
+    clock = cfg.pop("clock", FakeClock())
+    rts = [
+        ContainerRuntime(svc, "doc", channels=(SharedMap("m"),)) for _ in range(n)
+    ]
+    summarizers = [
+        RunningSummarizer(rt, SummaryConfig(clock=clock, **cfg)) for rt in rts
+    ]
+    for rt, s in zip(rts, summarizers):
+        rt.on_op = s.on_op
+    return svc, rts, summarizers, clock
+
+
+def test_election_oldest_write_client():
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "doc", channels=(SharedMap("m"),))
+    b = ContainerRuntime(svc, "doc", channels=(SharedMap("m"),))
+    drain([a, b])
+    ea, eb = SummarizerElection(a), SummarizerElection(b)
+    assert ea.is_elected and not eb.is_elected
+    assert ea.elected_client_id == a.client_id == eb.elected_client_id
+
+
+def test_read_client_ineligible():
+    svc = LocalFluidService()
+    r = ContainerRuntime(svc, "doc", channels=(SharedMap("m"),), mode="read")
+    w = ContainerRuntime(svc, "doc", channels=(SharedMap("m"),))
+    drain([r, w])
+    assert not SummarizerElection(r).is_elected
+    assert SummarizerElection(w).is_elected
+    assert not r.is_summarizer and w.is_summarizer
+
+
+def test_max_ops_heuristic_fires_only_on_elected():
+    svc, (a, b), (sa, sb), clock = make(max_ops=5, max_time_s=1e9)
+    m = a.get_channel("m")
+    for i in range(6):
+        m.set(f"k{i}", i)
+    drain([a, b])
+    assert sa.summaries_submitted == 1
+    assert sb.summaries_submitted == 0
+    drain([a, b])  # deliver the ack
+    assert sa.collection.latest_ack_head > 0
+    assert sb.collection.latest_ack_head == sa.collection.latest_ack_head
+    assert a.last_summary_seq > 0
+
+
+def test_max_time_heuristic():
+    svc, (a, b), (sa, sb), clock = make(max_ops=10_000, max_time_s=30.0)
+    a.get_channel("m").set("k", 1)
+    drain([a, b])
+    assert sa.summaries_submitted == 0  # too few ops, too soon
+    clock.now += 31
+    sa.tick()
+    assert sa.summaries_submitted == 1
+
+
+def test_election_moves_on_leave():
+    svc, (a, b), (sa, sb), clock = make(max_ops=2, max_time_s=1e9)
+    drain([a, b])
+    a.disconnect()
+    b.process_incoming()
+    assert SummarizerElection(b).is_elected
+    m = b.get_channel("m")
+    m.set("x", 1)
+    m.set("y", 2)
+    drain([b])
+    assert sb.summaries_submitted == 1
+
+
+def test_ack_resets_cycle_and_counts():
+    svc, (a, b), (sa, sb), clock = make(max_ops=3, max_time_s=1e9)
+    m = a.get_channel("m")
+    for i in range(3):
+        m.set(f"a{i}", i)
+    drain([a, b])
+    first = sa.summaries_submitted
+    assert first == 1
+    for i in range(3):
+        m.set(f"b{i}", i)
+    drain([a, b])
+    assert sa.summaries_submitted == 2
+    assert sa.collection.latest_ack_head >= 4
+
+
+def test_load_from_heuristic_summary():
+    svc, (a, b), (sa, sb), clock = make(max_ops=4, max_time_s=1e9)
+    m = a.get_channel("m")
+    for i in range(5):
+        m.set(f"k{i}", i)
+    drain([a, b])
+    c = ContainerRuntime(svc, "doc", channels=(SharedMap("m"),))
+    assert c.get_channel("m").get("k4") == 4
+    assert c.last_summary_seq > 0
