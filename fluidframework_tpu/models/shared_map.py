@@ -81,6 +81,12 @@ class SharedMap(SharedObject):
             }
             return
         key = c["key"]
+        if self._pending.get("\0clear", 0) > 0:
+            # A local clear is in flight: it will sequence after this op
+            # and wipe the key, so applying it here would diverge from
+            # replicas that see set-then-clear (reference mapKernel
+            # pendingClearMessageId shadowing).
+            return
         if self._pending.get(key, 0) > 0:
             return  # local pending op on this key wins until acked
         if c["k"] == "set":

@@ -23,7 +23,7 @@ from fluidframework_tpu.ops.merge_kernel import compact, jit_apply_ops
 from fluidframework_tpu.ops.segment_state import (
     capacity_of,
     grow,
-    make_state,
+    make_interactive_state,
     to_host,
 )
 from fluidframework_tpu.protocol.constants import (
@@ -41,7 +41,7 @@ class _PermutationVector:
     """One axis's order: a kernel-backed sequence of handle runs."""
 
     def __init__(self, capacity: int, self_client: int):
-        self.state = make_state(capacity, self_client)
+        self.state = make_interactive_state(capacity, self_client)
 
     def apply(self, row: np.ndarray) -> None:
         self.state = jit_apply_ops(self.state, row[None, :].astype(np.int32))

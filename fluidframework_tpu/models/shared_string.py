@@ -24,7 +24,7 @@ from fluidframework_tpu.ops.merge_kernel import compact, jit_apply_ops
 from fluidframework_tpu.ops.segment_state import (
     capacity_of,
     grow,
-    make_state,
+    make_interactive_state,
     materialize,
     to_host,
 )
@@ -54,7 +54,7 @@ class SharedString(SharedObject):
 
     def attach(self, runtime) -> None:
         super().attach(runtime)
-        self._state = make_state(self._capacity, self.client_id)
+        self._state = make_interactive_state(self._capacity, self.client_id)
 
     # -- reads ----------------------------------------------------------------
 
@@ -356,7 +356,7 @@ class SharedString(SharedObject):
         }
 
     def load_core(self, summary: dict) -> None:
-        st = make_state(max(self._capacity, summary["count"] + 16), self.client_id)
+        st = make_interactive_state(max(self._capacity, summary["count"] + 16), self.client_id)
         h = to_host(st)
         import jax.numpy as jnp
 

@@ -70,6 +70,38 @@ SEGMENT_LANES = (
 )
 
 
+def interactive_device():
+    """Device for per-op interactive applies: the host CPU backend.
+
+    A single client editing one document applies one small op at a time —
+    latency-bound, not throughput-bound — so the XLA:CPU backend is the
+    right executor (an accelerator round-trip per keystroke, possibly over
+    a network tunnel, costs orders of magnitude more than the op). The
+    service-scale paths (``make_batched_state`` + ``batched_apply_ops``,
+    ``parallel.mesh.DocShard``) keep the default device: there the work is
+    thousands of documents per dispatch and belongs on the TPU mesh.
+    """
+    import jax
+
+    try:
+        return jax.local_devices(backend="cpu")[0]
+    except RuntimeError:  # pragma: no cover - cpu backend always exists
+        return jax.devices()[0]
+
+
+def make_interactive_state(
+    capacity: int, self_client: int, min_seq: int = 0
+) -> SegmentState:
+    """``make_state`` committed to the interactive (CPU) device: every
+    subsequent jit on it executes host-side, keeping single-op DDS latency
+    off the accelerator round-trip path."""
+    import jax
+
+    return jax.device_put(
+        make_state(capacity, self_client, min_seq), interactive_device()
+    )
+
+
 def make_state(capacity: int, self_client: int, min_seq: int = 0) -> SegmentState:
     """Fresh empty document state with room for ``capacity`` segment rows."""
     def z():

@@ -15,7 +15,6 @@ from __future__ import annotations
 from typing import Any, Dict, Optional, Tuple
 
 from fluidframework_tpu.protocol.types import SequencedDocumentMessage
-from fluidframework_tpu.runtime.handles import collect_handle_routes
 from fluidframework_tpu.runtime.shared_object import SharedObject
 
 
@@ -93,13 +92,9 @@ class FluidDataStore(SharedObject):
             if cid in self.channels:
                 self.channels[cid].load_core(ch_summary)
 
-    def get_gc_data(self) -> Dict[str, list]:
-        """Outbound routes per child node (reference ``getGCData``): every
-        handle stored in a child's current state references its target."""
-        return {
-            self.handle_route(cid): collect_handle_routes(ch.summarize_core())
-            for cid, ch in self.channels.items()
-        }
+    # GC data (reference ``getGCData``) is derived by the container's
+    # ``run_gc`` from this datastore's already-computed summary — per-child
+    # nodes with child->parent edges — rather than re-summarizing here.
 
     # -- lifecycle forwarding --------------------------------------------------
 

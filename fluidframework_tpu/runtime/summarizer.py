@@ -97,6 +97,7 @@ class RunningSummarizer:
         self.election = SummarizerElection(container)
         self.collection = SummaryCollection()
         self._last_summary_time = self.config.clock()
+        self._last_attempt_time = self.config.clock()
         self._attempt: Optional[SummaryAttempt] = None
         self._attempts_this_cycle = 0
         self.summaries_submitted = 0
@@ -140,7 +141,13 @@ class RunningSummarizer:
         if ops_since < self.config.min_ops_for_attempt:
             return
         if self._attempts_this_cycle >= self.config.max_attempts:
-            return  # give up this cycle (reference stopReason maxAttempts)
+            # Throttled respawn (reference SummaryManager restarts the
+            # summarizer after stopReason maxAttempts): a fresh cycle opens
+            # after max_time_s — never give up for the container lifetime.
+            last = self._attempt.submitted_at if self._attempt else self._last_attempt_time
+            if self.config.clock() - last < self.config.max_time_s:
+                return
+            self._attempts_this_cycle = 0
         elapsed = self.config.clock() - self._last_summary_time
         if ops_since >= self.config.max_ops or elapsed >= self.config.max_time_s:
             self._submit()
@@ -152,5 +159,6 @@ class RunningSummarizer:
             head=self._container.ref_seq,
             submitted_at=self.config.clock(),
         )
+        self._last_attempt_time = self._attempt.submitted_at
         self._attempts_this_cycle += 1
         self.summaries_submitted += 1

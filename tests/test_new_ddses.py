@@ -1,0 +1,275 @@
+"""SharedDirectory, Ink, SharedSummaryBlock, IdCompressor.
+
+Reference coverage: packages/dds/map SharedDirectory (directory.ts),
+packages/dds/ink, packages/dds/shared-summary-block, and
+packages/dds/tree/src/id-compressor (SURVEY.md §2.2) — multi-client
+convergence through the in-proc ordering service (§4 layer 2).
+"""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.models.id_compressor import IdCompressor
+from fluidframework_tpu.models.ink import Ink
+from fluidframework_tpu.models.shared_directory import SharedDirectory
+from fluidframework_tpu.models.summary_block import SharedSummaryBlock
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service.local_server import LocalFluidService
+
+
+def make(n, channels_fn):
+    svc = LocalFluidService()
+    return svc, [
+        ContainerRuntime(svc, "doc", channels=channels_fn()) for _ in range(n)
+    ]
+
+
+def drain(rts):
+    for rt in rts:
+        rt.flush()
+    while any(rt.process_incoming() for rt in rts):
+        pass
+
+
+class TestSharedDirectory:
+    def test_root_and_nested_keys_converge(self):
+        svc, (a, b) = make(2, lambda: (SharedDirectory("d"),))
+        da, db = a.get_channel("d"), b.get_channel("d")
+        da.set("top", 1)
+        wa = da.create_subdirectory("ws")
+        wa.set("x", 10)
+        wa.create_subdirectory("deep").set("y", 20)
+        drain([a, b])
+        assert db.get("top") == 1
+        wb = db.get_subdirectory("ws")
+        assert wb.get("x") == 10
+        assert wb.get_subdirectory("deep").get("y") == 20
+        assert [n for n, _ in db.root.subdirectories()] == ["ws"]
+
+    def test_same_key_lww_and_local_pending_wins(self):
+        svc, (a, b) = make(2, lambda: (SharedDirectory("d"),))
+        da, db = a.get_channel("d"), b.get_channel("d")
+        da.create_subdirectory("s")
+        drain([a, b])
+        da.get_subdirectory("s").set("k", "a")
+        db.get_subdirectory("s").set("k", "b")
+        drain([a, b])
+        assert da.get_subdirectory("s").get("k") == db.get_subdirectory("s").get("k")
+
+    def test_rmdir_drops_subtree_everywhere(self):
+        svc, (a, b) = make(2, lambda: (SharedDirectory("d"),))
+        da, db = a.get_channel("d"), b.get_channel("d")
+        da.create_subdirectory("gone").set("k", 1)
+        da.get_subdirectory("gone").create_subdirectory("below").set("z", 2)
+        drain([a, b])
+        assert db.get_subdirectory("gone").get_subdirectory("below").get("z") == 2
+        db.root.delete_subdirectory("gone")
+        drain([a, b])
+        assert da.get_subdirectory("gone") is None
+        assert db.get_subdirectory("gone") is None
+
+    def test_set_under_concurrently_deleted_subtree_dropped(self):
+        svc, (a, b) = make(2, lambda: (SharedDirectory("d"),))
+        da, db = a.get_channel("d"), b.get_channel("d")
+        da.create_subdirectory("s")
+        drain([a, b])
+        # Concurrent: a writes under /s while b deletes /s.
+        da.get_subdirectory("s").set("k", 1)
+        db.root.delete_subdirectory("s")
+        drain([a, b])
+        assert da.get_subdirectory("s") is None
+        assert db.get_subdirectory("s") is None
+
+    def test_clear_total_order_semantics(self):
+        # Case 1: set sequences before clear -> the clear wipes it on every
+        # replica (including the setter, whose set was acked first).
+        svc, (a, b) = make(2, lambda: (SharedDirectory("d"),))
+        da, db = a.get_channel("d"), b.get_channel("d")
+        da.set("stale", 1)
+        drain([a, b])
+        da.set("mine", 2)
+        db.root.clear()
+        drain([a, b])  # a flushes first: set @ N, clear @ N+1
+        assert not da.has("mine") and not db.has("mine")
+        assert not da.has("stale") and not db.has("stale")
+
+        # Case 2: clear sequences before set -> the set survives everywhere.
+        db.root.clear()
+        da.set("keep", 3)
+        for rt in (b, a):  # b flushes first: clear @ M, set @ M+1
+            rt.flush()
+        drain([a, b])
+        assert da.get("keep") == 3 and db.get("keep") == 3
+
+    def test_summary_roundtrip(self):
+        svc, (a,) = make(1, lambda: (SharedDirectory("d"),))
+        d = a.get_channel("d")
+        d.set("k", 1)
+        d.create_subdirectory("s").set("x", [1, 2])
+        drain([a])
+        a.submit_summary()
+        drain([a])
+        b = ContainerRuntime(svc, "doc", channels=(SharedDirectory("d"),))
+        assert b.get_channel("d").get("k") == 1
+        assert b.get_channel("d").get_subdirectory("s").get("x") == [1, 2]
+
+
+class TestInk:
+    def test_strokes_converge(self):
+        svc, (a, b) = make(2, lambda: (Ink("ink"),))
+        ia, ib = a.get_channel("ink"), b.get_channel("ink")
+        sa = ia.create_stroke({"color": "red"})
+        ia.append_points(sa.id, [[0, 0, 0.0, 1.0], [1, 1, 0.1, 1.0]])
+        sb = ib.create_stroke({"color": "blue"})
+        ib.append_points(sb.id, [[5, 5, 0.0, 0.5]])
+        drain([a, b])
+        assert [s.id for s in ia.strokes()] == [s.id for s in ib.strokes()]
+        assert ib.get_stroke(sa.id).points.shape == (2, 4)
+        assert ia.get_stroke(sb.id).pen == {"color": "blue"}
+        np.testing.assert_array_equal(
+            ia.get_stroke(sa.id).points, ib.get_stroke(sa.id).points
+        )
+
+    def test_incremental_appends_in_order(self):
+        svc, (a, b) = make(2, lambda: (Ink("ink"),))
+        ia, ib = a.get_channel("ink"), b.get_channel("ink")
+        s = ia.create_stroke()
+        for i in range(5):
+            ia.append_points(s.id, [[i, i, i * 0.1, 1.0]])
+            drain([a, b])
+        pts = ib.get_stroke(s.id).points
+        np.testing.assert_allclose(pts[:, 0], np.arange(5, dtype=np.float32))
+
+    def test_clear_and_summary(self):
+        svc, (a,) = make(1, lambda: (Ink("ink"),))
+        ink = a.get_channel("ink")
+        s = ink.create_stroke()
+        ink.append_points(s.id, [[1, 2, 3, 4]])
+        drain([a])
+        a.submit_summary()
+        drain([a])
+        b = ContainerRuntime(svc, "doc", channels=(Ink("ink"),))
+        assert len(b.get_channel("ink").strokes()) == 1
+        ink.clear()
+        drain([a])
+        assert ink.strokes() == []
+
+
+class TestSharedSummaryBlock:
+    def test_rides_summary_not_ops(self):
+        svc, (a, b) = make(2, lambda: (SharedSummaryBlock("sb"),))
+        a.get_channel("sb").set("index", {"terms": 40})
+        drain([a, b])
+        # No op traffic: b does not see it live.
+        assert b.get_channel("sb").get("index") is None
+        a.submit_summary()
+        drain([a, b])
+        c = ContainerRuntime(svc, "doc", channels=(SharedSummaryBlock("sb"),))
+        assert c.get_channel("sb").get("index") == {"terms": 40}
+
+
+class TestIdCompressor:
+    def mk(self, svc=None):
+        svc = svc or LocalFluidService()
+        mk1 = lambda s: ContainerRuntime(
+            svc, "doc", channels=(IdCompressor("ids", cluster_capacity=8,
+                                              session_id=s),)
+        )
+        return svc, mk1("sess-a"), mk1("sess-b")
+
+    def test_locals_usable_immediately_then_finalize(self):
+        svc, a, b = self.mk()
+        ca = a.get_channel("ids")
+        ids = ca.generate_ids(3)
+        assert ids == [-1, -2, -3]
+        assert ca.normalize_to_final(-1) is None  # not yet finalized
+        ca.take_id_range()
+        drain([a, b])
+        assert [ca.normalize_to_final(i) for i in ids] == [0, 1, 2]
+
+    def test_cross_session_disjoint_and_convergent(self):
+        svc, a, b = self.mk()
+        ca, cb = a.get_channel("ids"), b.get_channel("ids")
+        ca.generate_ids(3)
+        cb.generate_ids(2)
+        ca.take_id_range()
+        cb.take_id_range()
+        drain([a, b])
+        fa = [ca.normalize_to_final(-i) for i in (1, 2, 3)]
+        fb = [cb.normalize_to_final(-i) for i in (1, 2)]
+        assert set(fa).isdisjoint(fb)
+        # Both replicas agree on every mapping.
+        for f in fa:
+            assert ca.decompress(f) == cb.decompress(f) == ("sess-a", fa.index(f))
+        for f in fb:
+            assert ca.decompress(f)[0] == "sess-b"
+        assert ca.recompress("sess-b", 0) == fb[0]
+
+    def test_cluster_reuse_keeps_ids_dense(self):
+        svc, a, b = self.mk()
+        ca = a.get_channel("ids")
+        ca.generate_ids(3)
+        ca.take_id_range()
+        drain([a, b])
+        ca.generate_ids(3)
+        ca.take_id_range()
+        drain([a, b])
+        # Second range fills the same 8-capacity cluster: finals 3..5.
+        assert [ca.normalize_to_final(-i) for i in (4, 5, 6)] == [3, 4, 5]
+        assert ca._next_final == 8  # still one cluster reserved
+
+    def test_overflow_allocates_new_cluster(self):
+        svc, a, b = self.mk()
+        ca, cb = a.get_channel("ids"), b.get_channel("ids")
+        ca.generate_ids(8)
+        ca.take_id_range()
+        cb.generate_ids(1)
+        cb.take_id_range()
+        drain([a, b])
+        ca.generate_ids(2)  # overflows sess-a's first cluster
+        ca.take_id_range()
+        drain([a, b])
+        finals = [ca.normalize_to_final(-i) for i in (9, 10)]
+        assert finals[0] >= 16  # lands past sess-b's cluster
+        assert ca.decompress(finals[1]) == ("sess-a", 9)
+        assert cb.decompress(finals[1]) == ("sess-a", 9)
+
+    def test_summary_roundtrip(self):
+        svc, a, b = self.mk()
+        ca = a.get_channel("ids")
+        ca.generate_ids(3)
+        ca.take_id_range()
+        drain([a, b])
+        a.submit_summary()
+        drain([a, b])
+        c = ContainerRuntime(
+            svc, "doc",
+            channels=(IdCompressor("ids", cluster_capacity=8, session_id="sess-c"),),
+        )
+        cc = c.get_channel("ids")
+        assert cc.decompress(2) == ("sess-a", 2)
+        cc.generate_ids(1)
+        cc.take_id_range()
+        drain([c, a, b])
+        assert cc.normalize_to_final(-1) == 8
+        assert ca.decompress(8) == ("sess-c", 0)
+
+
+class TestSharedMapClearShadowing:
+    def test_remote_set_during_pending_local_clear(self):
+        """Mirror of the SharedDirectory case on SharedMap (mapKernel
+        pendingClearMessageId): a remote set arriving while our clear is
+        in flight must not apply — the clear sequences later and wins."""
+        from fluidframework_tpu.models.shared_map import SharedMap
+
+        svc = LocalFluidService()
+        a = ContainerRuntime(svc, "doc", channels=(SharedMap("m"),))
+        b = ContainerRuntime(svc, "doc", channels=(SharedMap("m"),))
+        ma, mb = a.get_channel("m"), b.get_channel("m")
+        ma.set("x", 1)
+        drain([a, b])
+        ma.set("y", 2)
+        mb.clear()
+        drain([a, b])  # a flushes first: set @ N, clear @ N+1 wins
+        assert not ma.has("y") and not mb.has("y")
+        assert not ma.has("x") and not mb.has("x")

@@ -125,3 +125,32 @@ def test_load_from_heuristic_summary():
     c = ContainerRuntime(svc, "doc", channels=(SharedMap("m"),))
     assert c.get_channel("m").get("k4") == 4
     assert c.last_summary_seq > 0
+
+
+def test_retry_cycle_reopens_after_throttle():
+    """After max_attempts nacks the summarizer must not give up forever:
+    a new cycle opens after max_time_s (reference SummaryManager restart
+    throttling after stopReason maxAttempts)."""
+    svc = LocalFluidService()
+    clock = FakeClock()
+    a = ContainerRuntime(svc, "doc", channels=(SharedMap("m"),))
+    sa = RunningSummarizer(a, SummaryConfig(max_ops=2, max_time_s=50.0, clock=clock))
+    a.on_op = sa.on_op
+    # Break the store so every summary nacks (scribe: handle not found).
+    real_put = svc.store.put_summary
+    svc.store.put_summary = lambda s: "bogus-handle"
+    m = a.get_channel("m")
+    m.set("x", 1)
+    m.set("y", 2)
+    drain([a])
+    for _ in range(6):
+        sa.tick()
+        drain([a])
+    assert sa.summaries_submitted == 3  # max_attempts, then throttled
+    # Heal the store and advance past the throttle window.
+    svc.store.put_summary = real_put
+    clock.now += 60
+    sa.tick()
+    drain([a])
+    assert sa.summaries_submitted == 4
+    assert sa.collection.latest_ack_head > 0
