@@ -1,0 +1,30 @@
+"""Drivers — client ⇄ service adapters.
+
+Reference: ``packages/common/driver-definitions`` (``IDocumentService``
+storage.ts:308 with its three sub-services: storage, delta storage, delta
+connection) and ``packages/drivers/*`` — local-driver (in-proc test
+backbone), replay-driver (stored-op-stream playback), file-driver
+(snapshots+ops on disk). The contract here is the surface
+``ContainerRuntime`` consumes: ``connect() -> connection`` (live stream),
+``get_deltas`` (historical fetch), ``store`` (summary storage).
+"""
+
+from fluidframework_tpu.drivers.file_driver import (
+    FileDocumentService,
+    load_document,
+    save_document,
+)
+from fluidframework_tpu.drivers.local_driver import (
+    LocalDocumentServiceFactory,
+    resolve_url,
+)
+from fluidframework_tpu.drivers.replay_driver import ReplayDocumentService
+
+__all__ = [
+    "FileDocumentService",
+    "LocalDocumentServiceFactory",
+    "ReplayDocumentService",
+    "load_document",
+    "resolve_url",
+    "save_document",
+]

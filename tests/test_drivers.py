@@ -1,0 +1,132 @@
+"""Driver layer: local factory/url-resolution, file capture, replay.
+
+Reference: packages/drivers/* — local-driver, file-driver, replay-driver
+(SURVEY.md §2.3). The replay flow is BASELINE.json config 1's harness:
+capture a session, then play the op log into a fresh read-only container
+and land on the identical state, stoppable at any intermediate seq.
+"""
+
+import pytest
+
+from fluidframework_tpu.drivers import (
+    LocalDocumentServiceFactory,
+    load_document,
+    resolve_url,
+    save_document,
+)
+from fluidframework_tpu.models.shared_map import SharedMap
+from fluidframework_tpu.models.shared_string import SharedString
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service.local_server import LocalFluidService
+
+
+def drain(rts):
+    for rt in rts:
+        rt.flush()
+    while any(rt.process_incoming() for rt in rts):
+        pass
+
+
+def record_session(svc, doc="doc"):
+    a = ContainerRuntime(svc, doc, channels=(SharedString("s"), SharedMap("m")))
+    b = ContainerRuntime(svc, doc, channels=(SharedString("s"), SharedMap("m")))
+    a.get_channel("s").insert_text(0, "hello ")
+    b.get_channel("m").set("k", 1)
+    drain([a, b])
+    b.get_channel("s").insert_text(6, "world")
+    a.get_channel("m").set("k", 2)
+    drain([a, b])
+    a.get_channel("s").remove_range(0, 3)
+    drain([a, b])
+    return a, b
+
+
+class TestLocalDriver:
+    def test_url_resolution(self):
+        assert resolve_url("fluid-test://host/doc-1") == "doc-1"
+        assert resolve_url("fluid-test://host/abc/path/x") == "abc"
+        with pytest.raises(AssertionError):
+            resolve_url("https://elsewhere/doc")
+
+    def test_factory_binds_documents(self):
+        factory = LocalDocumentServiceFactory()
+        ds = factory.create_document_service("fluid-test://host/d1")
+        conn = ds.connect()
+        assert conn.client_id == 0
+        ds2 = factory.create_document_service("fluid-test://host/d1")
+        assert ds2.connect().client_id == 1  # same doc, same sequencer
+        assert factory.create_document_service(
+            "fluid-test://host/other"
+        ).connect().client_id == 0
+
+
+class TestFileAndReplay:
+    def test_capture_replay_full(self, tmp_path):
+        svc = LocalFluidService()
+        a, b = record_session(svc)
+        save_document(svc, "doc", str(tmp_path / "cap"))
+
+        fds = load_document(str(tmp_path / "cap"), doc_id="doc")
+        replay = fds.as_replay_service()
+        rt = ContainerRuntime(
+            replay, "doc", channels=(SharedString("s"), SharedMap("m")), mode="read"
+        )
+        assert rt.get_channel("s").get_text() == a.get_channel("s").get_text()
+        assert rt.get_channel("m").get("k") == a.get_channel("m").get("k")
+
+    def test_stepped_replay_intermediate_states(self, tmp_path):
+        svc = LocalFluidService()
+        a, b = record_session(svc)
+        save_document(svc, "doc", str(tmp_path / "cap"))
+
+        fds = load_document(str(tmp_path / "cap"), doc_id="doc")
+        replay = fds.as_replay_service(replay_to=0)
+        rt = ContainerRuntime(
+            replay, "doc", channels=(SharedString("s"), SharedMap("m")), mode="read"
+        )
+        assert rt.get_channel("s").get_text() == ""
+        states = []
+        head = max(m.sequence_number for m in fds.ops)
+        for seq in range(1, head + 1):
+            replay.replay_to(seq)
+            rt.process_incoming()
+            states.append(rt.get_channel("s").get_text())
+        assert states[-1] == a.get_channel("s").get_text()
+        # The text passed through its intermediate value before the remove.
+        assert "hello world" in states
+        assert rt.ref_seq == head
+
+    def test_replay_from_summary_snapshot(self, tmp_path):
+        svc = LocalFluidService()
+        a, b = record_session(svc)
+        a.submit_summary()
+        drain([a, b])
+        # More edits after the summary: replay must load snapshot + tail.
+        a.get_channel("s").insert_text(0, ">>")
+        drain([a, b])
+        save_document(svc, "doc", str(tmp_path / "cap"))
+
+        fds = load_document(str(tmp_path / "cap"), doc_id="doc")
+        assert fds.initial_summary is not None
+        rt = ContainerRuntime(
+            fds.as_replay_service(), "doc",
+            channels=(SharedString("s"), SharedMap("m")), mode="read",
+        )
+        assert rt.get_channel("s").get_text() == a.get_channel("s").get_text()
+        assert rt.last_summary_seq == fds.initial_summary[1]
+
+    def test_replay_is_readonly(self, tmp_path):
+        svc = LocalFluidService()
+        record_session(svc)
+        save_document(svc, "doc", str(tmp_path / "cap"))
+        fds = load_document(str(tmp_path / "cap"), doc_id="doc")
+        rt = ContainerRuntime(
+            fds.as_replay_service(), "doc",
+            channels=(SharedString("s"), SharedMap("m")), mode="read",
+        )
+        head = rt.ref_seq
+        # Local edits go nowhere: the stream never advances.
+        rt.get_channel("m").set("x", 1)
+        rt.flush()
+        rt.process_incoming()
+        assert rt.ref_seq == head
