@@ -1,0 +1,365 @@
+"""Partitioned stream-lambda framework + the four service lambdas.
+
+Reference: ``server/routerlicious`` —
+- ``lambdas-driver``: ``KafkaRunner`` -> ``PartitionManager`` (one ordered
+  queue per partition with a ``CheckpointManager``,
+  kafka-service/partitionManager.ts:25, checkpointManager.ts:10) ->
+  ``DocumentLambda``/``DocumentPartition`` demultiplexing a partition into
+  per-document lambdas (document-router/*.ts).
+- ``services-core/src/lambdas.ts``: ``IPartitionLambda`` (:72) /
+  ``IPartitionLambdaFactory`` (:88) — the plugin surface.
+- ``lambdas``: **deli** (sequencer, deli/lambda.ts:379), **scribe**
+  (summary validation + ack, scribe/lambda.ts:106), **scriptorium**
+  (op persistence, scriptorium/lambda.ts:32), **broadcaster**
+  (fan-out to client rooms, broadcaster/lambda.ts:62).
+
+Execution model: lambdas are stateless replayable consumers; durable
+state = checkpoints (offset + lambda state, reference ``IDeliState``
+document.ts:56) written on a max-messages heuristic. Delivery is
+at-least-once: a crash between produce and commit replays input, and the
+replay deterministically re-produces the *same* sequenced messages, which
+every downstream consumer absorbs idempotently (scriptorium upserts by
+seq, broadcaster drops seqs already delivered to a connection).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from fluidframework_tpu.protocol.types import (
+    DocumentMessage,
+    MessageType,
+    NackMessage,
+    SequencedDocumentMessage,
+)
+from fluidframework_tpu.service.queue import PartitionedLog
+from fluidframework_tpu.service.sequencer import (
+    DocumentSequencer,
+    SequencerCheckpoint,
+)
+
+RAW_TOPIC = "rawdeltas"
+DELTAS_TOPIC = "deltas"
+SIGNALS_TOPIC = "signals"
+
+
+# ---------------------------------------------------------------------------
+# Framework
+
+
+class PartitionLambda:
+    """IPartitionLambda: handle one record, emit (topic, key, value) tuples;
+    expose/restore durable state for checkpoints."""
+
+    def handler(self, key: str, value: Any) -> List[Tuple[str, str, Any]]:
+        raise NotImplementedError
+
+    def state(self) -> Any:
+        return None
+
+    @classmethod
+    def restore(cls, state: Any) -> "PartitionLambda":
+        raise NotImplementedError
+
+
+class CheckpointStore:
+    """Durable (in this harness: in-memory, survives lambda restarts)
+    checkpoint documents — the Mongo IDeliState/IScribe analog."""
+
+    def __init__(self) -> None:
+        self._data: Dict[Tuple[str, int], dict] = {}
+
+    def save(self, group: str, partition: int, offset: int, state: Any) -> None:
+        self._data[(group, partition)] = {
+            "offset": offset,
+            "state": copy.deepcopy(state),
+        }
+
+    def load(self, group: str, partition: int) -> Optional[dict]:
+        ent = self._data.get((group, partition))
+        return copy.deepcopy(ent) if ent else None
+
+
+class DocumentLambda(PartitionLambda):
+    """Demultiplexes one partition into per-document lambdas (the
+    document-router): every record's key is its document id; each document
+    gets its own lambda instance and strictly-ordered substream."""
+
+    def __init__(self, per_doc_factory: Callable[[str, Any], PartitionLambda]):
+        self._factory = per_doc_factory
+        self._docs: Dict[str, PartitionLambda] = {}
+
+    def doc(self, doc_id: str) -> PartitionLambda:
+        if doc_id not in self._docs:
+            self._docs[doc_id] = self._factory(doc_id, None)
+        return self._docs[doc_id]
+
+    def handler(self, key: str, value: Any) -> List[Tuple[str, str, Any]]:
+        return self.doc(key).handler(key, value)
+
+    def state(self) -> Any:
+        return {doc_id: lam.state() for doc_id, lam in self._docs.items()}
+
+    def restore_docs(self, state: Dict[str, Any]) -> None:
+        for doc_id, doc_state in (state or {}).items():
+            self._docs[doc_id] = self._factory(doc_id, doc_state)
+
+
+class PartitionRunner:
+    """One consumer group over one topic: per-partition ordered pump with
+    offset commit + state checkpoint every ``checkpoint_every`` messages
+    (KafkaRunner + PartitionManager + CheckpointManager collapsed for the
+    in-proc synchronous harness)."""
+
+    def __init__(
+        self,
+        log: PartitionedLog,
+        topic: str,
+        group: str,
+        factory: Callable[[int, Optional[Any]], PartitionLambda],
+        checkpoints: Optional[CheckpointStore] = None,
+        checkpoint_every: int = 10,
+    ):
+        self.log = log
+        self.topic = topic
+        self.group = group
+        self.checkpoints = checkpoints or CheckpointStore()
+        self.checkpoint_every = checkpoint_every
+        self._lambdas: Dict[int, PartitionLambda] = {}
+        self._offsets: Dict[int, int] = {}
+        self._since_checkpoint: Dict[int, int] = {}
+        for p in range(log.n_partitions):
+            saved = self.checkpoints.load(group, p)
+            self._lambdas[p] = factory(p, saved["state"] if saved else None)
+            self._offsets[p] = saved["offset"] if saved else 0
+            self._since_checkpoint[p] = 0
+
+    def pump(self) -> int:
+        """Drain every partition's backlog; returns records processed."""
+        n = 0
+        for p in range(self.log.n_partitions):
+            lam = self._lambdas[p]
+            while True:
+                recs = self.log.read(self.topic, p, self._offsets[p], limit=64)
+                if not recs:
+                    break
+                for rec in recs:
+                    for out_topic, out_key, out_value in lam.handler(
+                        rec.key, rec.value
+                    ):
+                        self.log.send(out_topic, out_key, out_value)
+                    self._offsets[p] = rec.offset + 1
+                    n += 1
+                    self._since_checkpoint[p] += 1
+                    if self._since_checkpoint[p] >= self.checkpoint_every:
+                        self.checkpoint(p)
+        return n
+
+    def checkpoint(self, partition: Optional[int] = None) -> None:
+        parts = range(self.log.n_partitions) if partition is None else [partition]
+        for p in parts:
+            self.checkpoints.save(
+                self.group, p, self._offsets[p], self._lambdas[p].state()
+            )
+            self.log.commit(self.group, self.topic, p, self._offsets[p])
+            self._since_checkpoint[p] = 0
+
+
+# ---------------------------------------------------------------------------
+# Deli — the sequencer lambda
+
+
+class DeliDocLambda(PartitionLambda):
+    """Per-document deli: wraps the pure DocumentSequencer ticket loop and
+    lowers raw control/op records to sequenced messages on ``deltas`` (and
+    signal numbers on ``signals``)."""
+
+    def __init__(self, doc_id: str, state: Optional[dict] = None):
+        self.doc_id = doc_id
+        checkpoint = None
+        self._signal_counter = 0
+        if state is not None:
+            checkpoint = SequencerCheckpoint(**state["sequencer"])
+            self._signal_counter = state["signals"]
+        self.sequencer = DocumentSequencer(doc_id, checkpoint)
+
+    def state(self) -> dict:
+        cp = self.sequencer.checkpoint()
+        return {
+            "sequencer": {
+                "sequence_number": cp.sequence_number,
+                "minimum_sequence_number": cp.minimum_sequence_number,
+                "clients": cp.clients,
+                "next_slot": cp.next_slot,
+            },
+            "signals": self._signal_counter,
+        }
+
+    def handler(self, key: str, value: dict) -> List[Tuple[str, str, Any]]:
+        t = value["t"]
+        out: List[Tuple[str, str, Any]] = []
+        if t == "join":
+            res = self.sequencer.join(value.get("mode", "write"))
+            if isinstance(res, NackMessage):
+                out.append(
+                    (DELTAS_TOPIC, key, {"t": "nack", "token": value.get("token"),
+                                         "nack": res})
+                )
+            else:
+                # The reply token rides the sequenced join so the front
+                # door can match slot assignments to connect calls.
+                res.contents = {**res.contents, "token": value.get("token")}
+                out.append((DELTAS_TOPIC, key, {"t": "seq", "msg": res}))
+        elif t == "leave":
+            res = self.sequencer.leave(value["client"])
+            if res is not None:
+                out.append((DELTAS_TOPIC, key, {"t": "seq", "msg": res}))
+        elif t == "op":
+            res = self.sequencer.ticket(value["client"], value["msg"])
+            if isinstance(res, NackMessage):
+                out.append(
+                    (DELTAS_TOPIC, key,
+                     {"t": "nack", "client": value["client"], "nack": res})
+                )
+            elif res is not None:
+                out.append((DELTAS_TOPIC, key, {"t": "seq", "msg": res}))
+            # duplicates (None) are dropped silently (checkOrder)
+        elif t == "summary_decision":
+            ack = self.sequencer._sequence_system(
+                MessageType.SUMMARY_ACK if value["ok"] else MessageType.SUMMARY_NACK,
+                contents={
+                    "handle": value["handle"],
+                    "summary_seq": value["summary_seq"],
+                    "head": value["head"],
+                },
+            )
+            out.append((DELTAS_TOPIC, key, {"t": "seq", "msg": ack}))
+        elif t == "signal":
+            self._signal_counter += 1
+            out.append(
+                (SIGNALS_TOPIC, key,
+                 {"client": value["client"], "num": self._signal_counter,
+                  "content": value["content"]})
+            )
+        else:  # pragma: no cover
+            raise ValueError(f"unknown raw record {value!r}")
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Scribe — summary validation + ack decision
+
+
+class ScribeDocLambda(PartitionLambda):
+    def __init__(self, doc_id: str, state: Optional[dict], store):
+        self.doc_id = doc_id
+        self.store = store
+        self.protocol_head = state["protocol_head"] if state else 0
+        self.latest_summary: Optional[tuple] = (
+            tuple(state["latest"]) if state and state["latest"] else None
+        )
+        self._decided: set = set(state["decided"]) if state else set()
+
+    def state(self) -> dict:
+        return {
+            "protocol_head": self.protocol_head,
+            "latest": list(self.latest_summary) if self.latest_summary else None,
+            "decided": sorted(self._decided),
+        }
+
+    def handler(self, key: str, value: dict) -> List[Tuple[str, str, Any]]:
+        if value["t"] != "seq":
+            return []
+        msg: SequencedDocumentMessage = value["msg"]
+        if msg.type != MessageType.SUMMARIZE:
+            return []
+        if msg.sequence_number in self._decided:
+            return []  # replay after crash: decision already produced
+        self._decided.add(msg.sequence_number)
+        handle = msg.contents["handle"]
+        head = msg.contents["head"]
+        ok = (
+            msg.reference_sequence_number >= self.protocol_head
+            and self.store.has(handle)
+        )
+        if ok:
+            self.latest_summary = (handle, head)
+            self.protocol_head = msg.sequence_number
+        return [
+            (RAW_TOPIC, key,
+             {"t": "summary_decision", "ok": ok, "handle": handle,
+              "head": head, "summary_seq": msg.sequence_number})
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Scriptorium — durable op log (the Mongo deltas collection)
+
+
+class ScriptoriumLambda(PartitionLambda):
+    """Idempotent insert of sequenced ops keyed by (doc, seq)."""
+
+    def __init__(self, ops_store: Dict[str, Dict[int, SequencedDocumentMessage]]):
+        self.ops_store = ops_store
+
+    def handler(self, key: str, value: dict) -> List[Tuple[str, str, Any]]:
+        if value["t"] == "seq":
+            msg = value["msg"]
+            self.ops_store.setdefault(key, {})[msg.sequence_number] = msg
+        return []
+
+    def state(self) -> Any:
+        return None  # the store itself is the durable artifact
+
+
+# ---------------------------------------------------------------------------
+# Broadcaster — fan-out to client connections (socket rooms)
+
+
+class BroadcasterLambda(PartitionLambda):
+    """Delivers sequenced ops to every connection in the document's room,
+    dropping anything a connection already saw (idempotent under replay)."""
+
+    def __init__(self, rooms: Dict[str, list]):
+        self.rooms = rooms
+
+    def handler(self, key: str, value: dict) -> List[Tuple[str, str, Any]]:
+        conns = self.rooms.get(key, [])
+        if value["t"] == "seq":
+            msg = value["msg"]
+            for conn in conns:
+                if msg.sequence_number > conn.delivered_seq:
+                    conn.inbox.append(msg)
+                    conn.delivered_seq = msg.sequence_number
+        elif value["t"] == "nack":
+            for conn in conns:
+                if value.get("client") == conn.client_id or (
+                    value.get("token") is not None
+                    and value.get("token") == conn.token
+                ):
+                    conn.nacks.append(value["nack"])
+                    if conn.on_nack:
+                        conn.on_nack(value["nack"])
+        return []
+
+
+class SignalBroadcasterLambda(PartitionLambda):
+    def __init__(self, rooms: Dict[str, list]):
+        self.rooms = rooms
+
+    def handler(self, key: str, value: dict) -> List[Tuple[str, str, Any]]:
+        from fluidframework_tpu.protocol.types import SignalMessage
+
+        for conn in self.rooms.get(key, []):
+            if value["num"] > conn.delivered_signal:
+                conn.signals.append(
+                    SignalMessage(
+                        client_id=value["client"],
+                        client_connection_number=value["num"],
+                        content=value["content"],
+                    )
+                )
+                conn.delivered_signal = value["num"]
+        return []

@@ -1,0 +1,272 @@
+"""The full ordering pipeline assembled from partitioned lambdas.
+
+Reference: the routerlicious op path (SURVEY.md §3.3) —
+``alfred -> Kafka(rawdeltas) -> deli -> Kafka(deltas) -> {scriptorium,
+scribe, broadcaster} -> client sockets`` — wired over the in-proc
+:class:`~fluidframework_tpu.service.queue.PartitionedLog` exactly as
+``memory-orderer/src/localOrderer.ts`` wires the production lambdas over
+``LocalKafka``. The front door (``PipelineFluidService``) exposes the same
+surface as ``LocalFluidService`` so any ContainerRuntime runs unchanged on
+the full pipeline; crash recovery = restart a runner from its checkpoint
+and replay (deterministic re-production, idempotent consumers).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional
+
+from fluidframework_tpu.protocol.types import (
+    DocumentMessage,
+    MessageType,
+    NackMessage,
+    SequencedDocumentMessage,
+    SignalMessage,
+)
+from fluidframework_tpu.service.lambdas import (
+    DELTAS_TOPIC,
+    RAW_TOPIC,
+    SIGNALS_TOPIC,
+    BroadcasterLambda,
+    CheckpointStore,
+    DeliDocLambda,
+    DocumentLambda,
+    PartitionRunner,
+    ScribeDocLambda,
+    ScriptoriumLambda,
+    SignalBroadcasterLambda,
+)
+from fluidframework_tpu.service.queue import PartitionedLog
+from fluidframework_tpu.service.summary_store import SummaryStore
+
+
+class PipelineConnection:
+    """Client connection surface (same as LocalConnection) fed by the
+    broadcaster lambda instead of directly by the sequencer."""
+
+    def __init__(self, service: "PipelineFluidService", doc_id: str, token: str):
+        self.doc_id = doc_id
+        self.token = token
+        self.client_id: int = -1  # set once the sequenced join arrives
+        self.service = service
+        self.inbox: List[SequencedDocumentMessage] = []
+        self.signals: List[SignalMessage] = []
+        self.nacks: List[NackMessage] = []
+        self.on_nack: Optional[Callable[[NackMessage], None]] = None
+        self.initial_summary: Optional[tuple] = None
+        self.delivered_seq = 0  # replay-idempotence watermark
+        self.delivered_signal = 0
+
+    def submit(self, msg: DocumentMessage) -> None:
+        self.service.submit(self.doc_id, self.client_id, msg)
+
+    def submit_signal(self, content) -> None:
+        self.service.submit_signal(self.doc_id, self.client_id, content)
+
+    def take_inbox(self, n: Optional[int] = None) -> List[SequencedDocumentMessage]:
+        self.service.pump()
+        n = len(self.inbox) if n is None else min(n, len(self.inbox))
+        out, self.inbox[:] = self.inbox[:n], self.inbox[n:]
+        return out
+
+    def disconnect(self) -> None:
+        self.service.disconnect(self.doc_id, self.client_id)
+
+
+class PipelineFluidService:
+    """Front door + lambda pipeline (alfred + localOrderer equivalent)."""
+
+    def __init__(self, n_partitions: int = 4, checkpoint_every: int = 10):
+        self.log = PartitionedLog(n_partitions)
+        self.store = SummaryStore()
+        self.checkpoints = CheckpointStore()
+        self.ops_store: Dict[str, Dict[int, SequencedDocumentMessage]] = {}
+        self.rooms: Dict[str, list] = {}
+        self._token_counter = itertools.count(1)
+        self._deli = self._make_deli(checkpoint_every)
+        self._scribe = self._make_scribe(checkpoint_every)
+        self._scriptorium = PartitionRunner(
+            self.log, DELTAS_TOPIC, "scriptorium",
+            lambda p, s: ScriptoriumLambda(self.ops_store),
+            self.checkpoints, checkpoint_every,
+        )
+        self._broadcaster = PartitionRunner(
+            self.log, DELTAS_TOPIC, "broadcaster",
+            lambda p, s: BroadcasterLambda(self.rooms),
+            self.checkpoints, checkpoint_every,
+        )
+        self._signals = PartitionRunner(
+            self.log, SIGNALS_TOPIC, "signal-broadcaster",
+            lambda p, s: SignalBroadcasterLambda(self.rooms),
+            self.checkpoints, checkpoint_every,
+        )
+
+    # -- lambda (re)construction: also the crash-recovery entry points --------
+
+    def _make_deli(self, checkpoint_every: int) -> PartitionRunner:
+        def factory(p: int, state):
+            lam = DocumentLambda(lambda doc_id, s: DeliDocLambda(doc_id, s))
+            lam.restore_docs(state)
+            return lam
+
+        return PartitionRunner(
+            self.log, RAW_TOPIC, "deli", factory, self.checkpoints,
+            checkpoint_every,
+        )
+
+    def _make_scribe(self, checkpoint_every: int) -> PartitionRunner:
+        def factory(p: int, state):
+            lam = DocumentLambda(
+                lambda doc_id, s: ScribeDocLambda(doc_id, s, self.store)
+            )
+            lam.restore_docs(state)
+            return lam
+
+        return PartitionRunner(
+            self.log, DELTAS_TOPIC, "scribe", factory, self.checkpoints,
+            checkpoint_every,
+        )
+
+    def crash_deli(self, checkpoint_every: int = 10) -> None:
+        """Kill the deli runner and restart it from its last checkpoint —
+        uncheckpointed input replays; output dedup is downstream."""
+        self._deli = self._make_deli(checkpoint_every)
+
+    def crash_scribe(self, checkpoint_every: int = 10) -> None:
+        self._scribe = self._make_scribe(checkpoint_every)
+
+    def checkpoint_all(self) -> None:
+        for r in (self._deli, self._scribe, self._scriptorium,
+                  self._broadcaster, self._signals):
+            r.checkpoint()
+
+    # -- the pipeline pump -----------------------------------------------------
+
+    def pump(self) -> int:
+        """Run every stage until the whole pipeline is quiescent (the
+        in-proc analog of the async Kafka stages all catching up)."""
+        total = 0
+        while True:
+            n = (
+                self._deli.pump()
+                + self._scribe.pump()
+                + self._scriptorium.pump()
+                + self._broadcaster.pump()
+                + self._signals.pump()
+            )
+            total += n
+            if n == 0:
+                return total
+
+    # -- the LocalFluidService-compatible surface ------------------------------
+
+    def connect(
+        self, doc_id: str, mode: str = "write", from_seq: int = 0
+    ) -> PipelineConnection:
+        self.pump()  # settle before computing the catch-up point
+        token = f"c{next(self._token_counter)}"
+        conn = PipelineConnection(self, doc_id, token)
+        scribe_doc = self._scribe_doc(doc_id)
+        if from_seq == 0 and scribe_doc and scribe_doc.latest_summary:
+            conn.initial_summary = scribe_doc.latest_summary
+            from_seq = scribe_doc.latest_summary[1]
+        # Backfill from the durable op log, then join the live room.
+        for seq in sorted(self.ops_store.get(doc_id, {})):
+            if seq > from_seq:
+                conn.inbox.append(self.ops_store[doc_id][seq])
+                conn.delivered_seq = seq
+        conn.delivered_seq = max(conn.delivered_seq, from_seq)
+        self.rooms.setdefault(doc_id, []).append(conn)
+        self.log.send(RAW_TOPIC, doc_id, {"t": "join", "mode": mode, "token": token})
+        self.pump()
+        for msg in conn.inbox:
+            if (
+                msg.type == MessageType.CLIENT_JOIN
+                and msg.contents.get("token") == token
+            ):
+                conn.client_id = msg.contents["clientId"]
+                break
+        if conn.client_id < 0:
+            self.rooms[doc_id].remove(conn)
+            nack = conn.nacks[0] if conn.nacks else None
+            raise ConnectionError(nack.message if nack else "join failed")
+        return conn
+
+    def disconnect(self, doc_id: str, client_id: int) -> None:
+        self.rooms[doc_id] = [
+            c for c in self.rooms.get(doc_id, []) if c.client_id != client_id
+        ]
+        self.log.send(RAW_TOPIC, doc_id, {"t": "leave", "client": client_id})
+        self.pump()
+
+    def submit(self, doc_id: str, client_id: int, msg: DocumentMessage) -> None:
+        self.log.send(
+            RAW_TOPIC, doc_id, {"t": "op", "client": client_id, "msg": msg}
+        )
+        self.pump()
+
+    def submit_signal(self, doc_id: str, client_id: int, content) -> None:
+        self.log.send(
+            RAW_TOPIC, doc_id,
+            {"t": "signal", "client": client_id, "content": content},
+        )
+        self.pump()
+
+    def get_deltas(
+        self, doc_id: str, from_seq: int = 0, to_seq: Optional[int] = None
+    ) -> List[SequencedDocumentMessage]:
+        self.pump()
+        return [
+            m
+            for seq, m in sorted(self.ops_store.get(doc_id, {}).items())
+            if seq > from_seq and (to_seq is None or seq <= to_seq)
+        ]
+
+    def _scribe_doc(self, doc_id: str) -> Optional[ScribeDocLambda]:
+        from fluidframework_tpu.service.queue import partition_of
+
+        p = partition_of(doc_id, self.log.n_partitions)
+        lam = self._scribe._lambdas[p]
+        return lam._docs.get(doc_id)  # type: ignore[attr-defined]
+
+
+class ReservationManager:
+    """Document-placement leases for multi-node ordering.
+
+    Reference: ``memory-orderer/src/reservationManager.ts`` (+ the
+    ZooKeeper-style coordination of §2.9): a node must hold the document's
+    lease to run its sequencer; leases expire and transfer with a fenced
+    epoch so a stale owner can never write after takeover.
+    """
+
+    def __init__(self, clock: Callable[[], float]):
+        self._clock = clock
+        self._leases: Dict[str, dict] = {}
+
+    def acquire(self, node: str, doc_id: str, ttl_s: float) -> Optional[int]:
+        """Returns the fencing epoch if granted, None if another node holds
+        an unexpired lease."""
+        now = self._clock()
+        lease = self._leases.get(doc_id)
+        if lease is None or lease["node"] == node or lease["expires"] <= now:
+            epoch = (lease["epoch"] + 1) if lease and lease["node"] != node else (
+                lease["epoch"] if lease else 1
+            )
+            self._leases[doc_id] = {
+                "node": node, "expires": now + ttl_s, "epoch": epoch,
+            }
+            return epoch
+        return None
+
+    def renew(self, node: str, doc_id: str, ttl_s: float) -> bool:
+        lease = self._leases.get(doc_id)
+        if lease and lease["node"] == node and lease["expires"] > self._clock():
+            lease["expires"] = self._clock() + ttl_s
+            return True
+        return False
+
+    def holder(self, doc_id: str) -> Optional[str]:
+        lease = self._leases.get(doc_id)
+        if lease and lease["expires"] > self._clock():
+            return lease["node"]
+        return None

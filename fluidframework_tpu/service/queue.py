@@ -1,0 +1,81 @@
+"""Partitioned append-only log — the service's internal op bus.
+
+Reference: ``server/routerlicious/packages/services-core/src/queue.ts``
+(``IProducer`` :26 / ``IConsumer`` :84) over Kafka (librdkafka,
+``services-ordering-rdkafka``): topics are split into partitions by
+document key, each partition is a strictly-ordered append log, consumers
+track committed offsets and resume from them after a crash, and producers
+boxcar-batch messages per partition (``pendingBoxcar.ts``).
+
+In-proc Python backend here; ``utils.native.NativePartitionLog`` (C++,
+``native/partition_log.cpp``) provides the same interface persistently —
+both are accepted by the lambda framework.
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_PARTITIONS = 8  # reference config.json:38
+
+
+def partition_of(key: str, n_partitions: int) -> int:
+    """Stable document-key -> partition routing (Kafka key partitioner)."""
+    return zlib.crc32(key.encode()) % n_partitions
+
+
+@dataclass
+class LogRecord:
+    offset: int
+    key: str
+    value: Any
+
+
+class PartitionedLog:
+    """Topics of N ordered partitions with offset-based consumption."""
+
+    def __init__(self, n_partitions: int = DEFAULT_PARTITIONS):
+        self.n_partitions = n_partitions
+        # (topic, partition) -> list of LogRecord
+        self._logs: Dict[Tuple[str, int], List[LogRecord]] = {}
+        # (group, topic, partition) -> committed offset (next to consume)
+        self._commits: Dict[Tuple[str, str, int], int] = {}
+
+    # -- producer --------------------------------------------------------------
+
+    def send(self, topic: str, key: str, value: Any) -> Tuple[int, int]:
+        """Append one message; returns (partition, offset)."""
+        p = partition_of(key, self.n_partitions)
+        log = self._logs.setdefault((topic, p), [])
+        rec = LogRecord(offset=len(log), key=key, value=value)
+        log.append(rec)
+        return p, rec.offset
+
+    def send_batch(self, topic: str, entries: List[Tuple[str, Any]]) -> None:
+        """Boxcar append (pendingBoxcar.ts batching)."""
+        for key, value in entries:
+            self.send(topic, key, value)
+
+    # -- consumer --------------------------------------------------------------
+
+    def read(
+        self, topic: str, partition: int, from_offset: int, limit: Optional[int] = None
+    ) -> List[LogRecord]:
+        log = self._logs.get((topic, partition), [])
+        out = log[from_offset:]
+        return out if limit is None else out[:limit]
+
+    def end_offset(self, topic: str, partition: int) -> int:
+        return len(self._logs.get((topic, partition), []))
+
+    # -- consumer-group offset commits ----------------------------------------
+
+    def commit(self, group: str, topic: str, partition: int, offset: int) -> None:
+        key = (group, topic, partition)
+        assert offset >= self._commits.get(key, 0), "commits never rewind"
+        self._commits[key] = offset
+
+    def committed(self, group: str, topic: str, partition: int) -> int:
+        return self._commits.get((group, topic, partition), 0)

@@ -1,0 +1,137 @@
+// Lease-based coordination — native backend for document placement.
+//
+// The TPU framework's equivalent of the reference's ZooKeeper client
+// (zookeeper npm C binding, services-ordering-zookeeper) + the Mongo-backed
+// reservation manager (memory-orderer/src/reservationManager.ts): a node
+// must hold a document's lease to order it; leases carry a fenced epoch
+// that bumps on takeover so a stale owner can never write again. Time is
+// supplied by the caller (ms), keeping the library deterministic and
+// testable. Optionally durable to a single append-log file replayed on
+// open. C ABI via ctypes (fluidframework_tpu/utils/native.py).
+//
+// Build: make -C native   (produces libcoord.so)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct Lease {
+  std::string node;
+  int64_t expires_ms = 0;
+  int64_t epoch = 0;
+};
+
+struct Coord {
+  std::mutex mu;
+  std::map<std::string, Lease> leases;
+  std::string path;  // empty = memory-only
+
+  void persist(const std::string& doc, const Lease& l) {
+    if (path.empty()) return;
+    FILE* f = fopen(path.c_str(), "ab");
+    if (!f) return;
+    fprintf(f, "%s\x1f%s\x1f%lld\x1f%lld\n", doc.c_str(), l.node.c_str(),
+            (long long)l.expires_ms, (long long)l.epoch);
+    fclose(f);
+  }
+
+  void load() {
+    if (path.empty()) return;
+    FILE* f = fopen(path.c_str(), "rb");
+    if (!f) return;
+    char line[2048];
+    while (fgets(line, sizeof(line), f)) {
+      char* p1 = strchr(line, '\x1f');
+      if (!p1) continue;
+      char* p2 = strchr(p1 + 1, '\x1f');
+      if (!p2) continue;
+      char* p3 = strchr(p2 + 1, '\x1f');
+      if (!p3) continue;
+      Lease l;
+      l.node.assign(p1 + 1, p2 - p1 - 1);
+      l.expires_ms = atoll(p2 + 1);
+      l.epoch = atoll(p3 + 1);
+      leases[std::string(line, p1 - line)] = l;  // last write wins
+    }
+    fclose(f);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* coord_new(const char* path) {
+  Coord* c = new Coord();
+  if (path && path[0]) {
+    c->path = path;
+    c->load();
+  }
+  return c;
+}
+
+void coord_free(void* h) { delete static_cast<Coord*>(h); }
+
+// Returns the fencing epoch (>=1) when granted, 0 when another node holds
+// an unexpired lease.
+int64_t coord_acquire(void* h, const char* node, const char* doc,
+                      int64_t ttl_ms, int64_t now_ms) {
+  Coord* c = static_cast<Coord*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  auto it = c->leases.find(doc);
+  if (it != c->leases.end() && it->second.node != node &&
+      it->second.expires_ms > now_ms)
+    return 0;
+  Lease l;
+  l.node = node;
+  l.expires_ms = now_ms + ttl_ms;
+  if (it == c->leases.end()) {
+    l.epoch = 1;
+  } else {
+    l.epoch = it->second.node == node ? it->second.epoch : it->second.epoch + 1;
+  }
+  c->leases[doc] = l;
+  c->persist(doc, l);
+  return l.epoch;
+}
+
+// Extends a held, unexpired lease. Returns 1 on success.
+int coord_renew(void* h, const char* node, const char* doc, int64_t ttl_ms,
+                int64_t now_ms) {
+  Coord* c = static_cast<Coord*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  auto it = c->leases.find(doc);
+  if (it == c->leases.end() || it->second.node != node ||
+      it->second.expires_ms <= now_ms)
+    return 0;
+  it->second.expires_ms = now_ms + ttl_ms;
+  c->persist(doc, it->second);
+  return 1;
+}
+
+// Copies the holder's name into out; returns its length, or -1 when no
+// unexpired lease exists.
+int64_t coord_holder(void* h, const char* doc, int64_t now_ms, char* out,
+                     size_t cap) {
+  Coord* c = static_cast<Coord*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  auto it = c->leases.find(doc);
+  if (it == c->leases.end() || it->second.expires_ms <= now_ms) return -1;
+  if (it->second.node.size() > cap) return -2;
+  memcpy(out, it->second.node.data(), it->second.node.size());
+  return (int64_t)it->second.node.size();
+}
+
+int64_t coord_epoch(void* h, const char* doc) {
+  Coord* c = static_cast<Coord*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  auto it = c->leases.find(doc);
+  return it == c->leases.end() ? 0 : it->second.epoch;
+}
+
+}  // extern "C"

@@ -1,0 +1,219 @@
+// Partitioned append-only log — native backend for the service op bus.
+//
+// The TPU framework's equivalent of the reference's Kafka client
+// (librdkafka via node-rdkafka, services-ordering-rdkafka): topics split
+// into partitions by key CRC, each partition an ordered append log with
+// consumer-group offset commits. Optionally durable: records are framed
+// into one file per (topic, partition) and replayed on open, so a service
+// restart resumes from its committed offsets exactly as a Kafka consumer
+// group would. Exposed as a C ABI consumed via ctypes
+// (fluidframework_tpu/utils/native.py).
+//
+// Build: make -C native   (produces libplog.so)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <vector>
+
+namespace {
+
+uint32_t crc32_of(const uint8_t* data, size_t n) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Record {
+  std::string key;
+  std::string value;
+};
+
+struct PartitionFile {
+  std::vector<Record> records;
+  FILE* f = nullptr;  // append handle when durable
+};
+
+struct PLog {
+  int n_partitions;
+  std::string dir;  // empty = memory-only
+  std::mutex mu;
+  // (topic, partition) -> records
+  std::map<std::pair<std::string, int>, PartitionFile> parts;
+  // (group, topic, partition) -> committed offset
+  std::map<std::string, int64_t> commits;
+
+  std::string part_path(const std::string& topic, int p) const {
+    return dir + "/" + topic + "." + std::to_string(p) + ".log";
+  }
+  std::string commits_path() const { return dir + "/commits.log"; }
+
+  PartitionFile& part(const std::string& topic, int p) {
+    auto key = std::make_pair(topic, p);
+    auto it = parts.find(key);
+    if (it != parts.end()) return it->second;
+    PartitionFile& pf = parts[key];
+    if (!dir.empty()) {
+      // Replay any existing records, then reopen for append.
+      FILE* rf = fopen(part_path(topic, p).c_str(), "rb");
+      if (rf) {
+        while (true) {
+          uint32_t klen, vlen;
+          if (fread(&klen, 4, 1, rf) != 1) break;
+          if (fread(&vlen, 4, 1, rf) != 1) break;
+          Record r;
+          r.key.resize(klen);
+          r.value.resize(vlen);
+          if (klen && fread(&r.key[0], 1, klen, rf) != klen) break;
+          if (vlen && fread(&r.value[0], 1, vlen, rf) != vlen) break;
+          pf.records.push_back(std::move(r));
+        }
+        fclose(rf);
+      }
+      pf.f = fopen(part_path(topic, p).c_str(), "ab");
+    }
+    return pf;
+  }
+
+  void load_commits() {
+    if (dir.empty()) return;
+    FILE* f = fopen(commits_path().c_str(), "rb");
+    if (!f) return;
+    // Last write per key wins (the file is an append log of commits).
+    char line[1024];
+    while (fgets(line, sizeof(line), f)) {
+      char key[900];
+      long long off;
+      if (sscanf(line, "%899s %lld", key, &off) == 2) commits[key] = off;
+    }
+    fclose(f);
+  }
+
+  void persist_commit(const std::string& key, int64_t off) {
+    if (dir.empty()) return;
+    FILE* f = fopen(commits_path().c_str(), "ab");
+    if (!f) return;
+    fprintf(f, "%s %lld\n", key.c_str(), (long long)off);
+    fclose(f);
+  }
+};
+
+std::string commit_key(const char* group, const char* topic, int p) {
+  return std::string(group) + "\x1f" + topic + "\x1f" + std::to_string(p);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* plog_new(const char* dir, int n_partitions) {
+  PLog* log = new PLog();
+  log->n_partitions = n_partitions;
+  if (dir && dir[0]) {
+    log->dir = dir;
+    mkdir(dir, 0755);
+    log->load_commits();
+  }
+  return log;
+}
+
+void plog_free(void* h) { delete static_cast<PLog*>(h); }
+
+int plog_partition(void* h, const char* key) {
+  PLog* log = static_cast<PLog*>(h);
+  return (int)(crc32_of(reinterpret_cast<const uint8_t*>(key), strlen(key)) %
+               (uint32_t)log->n_partitions);
+}
+
+// Appends; returns the record's offset within its partition.
+int64_t plog_send(void* h, const char* topic, const char* key,
+                  const char* data, size_t len) {
+  PLog* log = static_cast<PLog*>(h);
+  std::lock_guard<std::mutex> lk(log->mu);
+  int p = plog_partition(h, key);
+  PartitionFile& pf = log->part(topic, p);
+  Record r;
+  r.key = key;
+  r.value.assign(data, len);
+  if (pf.f) {
+    uint32_t klen = (uint32_t)r.key.size(), vlen = (uint32_t)len;
+    fwrite(&klen, 4, 1, pf.f);
+    fwrite(&vlen, 4, 1, pf.f);
+    fwrite(r.key.data(), 1, klen, pf.f);
+    fwrite(data, 1, vlen, pf.f);
+    fflush(pf.f);
+  }
+  pf.records.push_back(std::move(r));
+  return (int64_t)pf.records.size() - 1;
+}
+
+int64_t plog_end_offset(void* h, const char* topic, int p) {
+  PLog* log = static_cast<PLog*>(h);
+  std::lock_guard<std::mutex> lk(log->mu);
+  return (int64_t)log->part(topic, p).records.size();
+}
+
+// Size of record value at offset, or -1 when out of range.
+int64_t plog_value_size(void* h, const char* topic, int p, int64_t off) {
+  PLog* log = static_cast<PLog*>(h);
+  std::lock_guard<std::mutex> lk(log->mu);
+  PartitionFile& pf = log->part(topic, p);
+  if (off < 0 || (size_t)off >= pf.records.size()) return -1;
+  return (int64_t)pf.records[off].value.size();
+}
+
+int64_t plog_key_size(void* h, const char* topic, int p, int64_t off) {
+  PLog* log = static_cast<PLog*>(h);
+  std::lock_guard<std::mutex> lk(log->mu);
+  PartitionFile& pf = log->part(topic, p);
+  if (off < 0 || (size_t)off >= pf.records.size()) return -1;
+  return (int64_t)pf.records[off].key.size();
+}
+
+int64_t plog_read(void* h, const char* topic, int p, int64_t off, char* key_out,
+                  size_t key_cap, char* value_out, size_t value_cap) {
+  PLog* log = static_cast<PLog*>(h);
+  std::lock_guard<std::mutex> lk(log->mu);
+  PartitionFile& pf = log->part(topic, p);
+  if (off < 0 || (size_t)off >= pf.records.size()) return -1;
+  const Record& r = pf.records[off];
+  if (r.key.size() > key_cap || r.value.size() > value_cap) return -2;
+  memcpy(key_out, r.key.data(), r.key.size());
+  memcpy(value_out, r.value.data(), r.value.size());
+  return (int64_t)r.value.size();
+}
+
+int plog_commit(void* h, const char* group, const char* topic, int p,
+                int64_t offset) {
+  PLog* log = static_cast<PLog*>(h);
+  std::lock_guard<std::mutex> lk(log->mu);
+  std::string key = commit_key(group, topic, p);
+  auto it = log->commits.find(key);
+  if (it != log->commits.end() && it->second > offset) return 0;  // no rewind
+  log->commits[key] = offset;
+  log->persist_commit(key, offset);
+  return 1;
+}
+
+int64_t plog_committed(void* h, const char* group, const char* topic, int p) {
+  PLog* log = static_cast<PLog*>(h);
+  std::lock_guard<std::mutex> lk(log->mu);
+  auto it = log->commits.find(commit_key(group, topic, p));
+  return it == log->commits.end() ? 0 : it->second;
+}
+
+}  // extern "C"
