@@ -1,0 +1,130 @@
+"""fluid-static — declarative containers: ContainerSchema + FluidContainer.
+
+Reference: ``packages/framework/fluid-static`` (``FluidContainer``
+fluidContainer.ts:201, ``ContainerSchema`` types.ts:66, ``RootDataObject``
+rootDataObject.ts:41,149): a schema names the initial objects a container is
+born with plus the dynamic types it may create later; the client facade
+turns that into a root data object whose channels are the initial objects,
+and ``FluidContainer.create`` makes detached dynamic objects that only
+survive while some reachable DDS stores their handle (GC, D.3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple, Type
+
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.runtime.datastore import FluidDataStore
+from fluidframework_tpu.runtime.shared_object import SharedObject
+
+# A loadable object type: any SharedObject subclass whose constructor takes
+# the channel id first (every DDS in models/ does).
+LoadableType = Type[SharedObject]
+
+ROOT_DO_ID = "rootDOId"  # reference rootDataObject.ts root datastore alias
+
+
+@dataclass(frozen=True)
+class ContainerSchema:
+    """Declarative shape of a container (reference types.ts:66).
+
+    ``initial_objects`` maps app-visible names to DDS types, created exactly
+    once at container creation and loadable forever after;
+    ``dynamic_object_types`` is the registry of types ``create`` may mint.
+    """
+
+    initial_objects: Dict[str, LoadableType]
+    dynamic_object_types: Tuple[LoadableType, ...] = ()
+
+
+class FluidContainer:
+    """App-facing container (reference fluidContainer.ts:201): hides the
+    runtime/datastore plumbing behind ``initial_objects`` + ``create``."""
+
+    def __init__(self, runtime: ContainerRuntime, schema: ContainerSchema):
+        self._runtime = runtime
+        self._schema = schema
+        self._root: FluidDataStore = runtime.channels[ROOT_DO_ID]  # type: ignore[assignment]
+        self._dynamic_seq = 0
+
+    # -- the schema surface ----------------------------------------------------
+
+    @property
+    def initial_objects(self) -> Dict[str, SharedObject]:
+        return {
+            name: self._root.get_channel(name)
+            for name in self._schema.initial_objects
+        }
+
+    def create(self, object_type: LoadableType) -> SharedObject:
+        """Create a dynamic object (fluidContainer.ts ``create``): it is NOT
+        rooted — the app must store its handle in a reachable DDS before the
+        next summary or GC sweeps it."""
+        assert object_type in self._schema.dynamic_object_types, (
+            f"{object_type.__name__} not in schema.dynamic_object_types"
+        )
+        self._dynamic_seq += 1
+        cid = f"dyn-{self._runtime.client_id}-{self._dynamic_seq}"
+        obj = object_type(cid)
+        # Replicated via an ATTACH op: every other client constructs it from
+        # the schema-derived type registry, so its ops and handles resolve
+        # everywhere, not just on the creating client.
+        self._runtime.attach_channel(obj, object_type.__name__)
+        return obj
+
+    def handle_of(self, obj: SharedObject) -> dict:
+        """Encoded handle for a created object (what you store in a DDS)."""
+        if obj.id in self._root.channels:
+            return self._runtime.handle_for(ROOT_DO_ID, obj.id)
+        return self._runtime.handle_for(obj.id)
+
+    def resolve_handle(self, handle: dict) -> SharedObject:
+        """Handle -> live object (reference IFluidHandle.get)."""
+        route = handle["url"] if isinstance(handle, dict) else handle
+        parts = route.lstrip("/").split("/")
+        channel = self._runtime.get_channel(parts[0])
+        for sub in parts[1:]:
+            channel = channel.get_channel(sub)  # type: ignore[attr-defined]
+        return channel
+
+    # -- lifecycle / state -----------------------------------------------------
+
+    @property
+    def connected(self) -> bool:
+        return self._runtime.connected
+
+    @property
+    def runtime(self) -> ContainerRuntime:
+        return self._runtime
+
+    @property
+    def audience(self) -> Dict[int, dict]:
+        """Connected clients (reference IAudience off the quorum)."""
+        return dict(self._runtime.quorum_members)
+
+    def disconnect(self) -> None:
+        self._runtime.disconnect()
+
+    def connect(self) -> None:
+        self._runtime.reconnect()
+
+    def dispose(self) -> None:
+        if self._runtime.connected:
+            self._runtime.disconnect()
+
+
+def schema_type_registry(schema: ContainerSchema) -> Dict[str, LoadableType]:
+    """Type-name registry for the runtime's dynamic-channel machinery."""
+    return {t.__name__: t for t in schema.dynamic_object_types}
+
+
+def build_root_datastore(schema: ContainerSchema) -> FluidDataStore:
+    """Root data object holding the schema's initial objects (reference
+    RootDataObject.initializingFirstTime rootDataObject.ts:149). Channel
+    construction is deterministic from the schema, so creating and loading
+    clients build identical channel trees before any op/summary applies."""
+    channels = tuple(
+        obj_type(name) for name, obj_type in sorted(schema.initial_objects.items())
+    )
+    return FluidDataStore(ROOT_DO_ID, channels)

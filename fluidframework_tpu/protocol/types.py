@@ -28,6 +28,7 @@ class MessageType(enum.IntEnum):
     NO_CLIENT = 9
     CONTROL = 10
     SIGNAL = 11
+    ATTACH = 12  # dynamic channel/datastore creation (reference "attach" op)
 
 
 class NackErrorType(enum.IntEnum):

@@ -48,6 +48,7 @@ class ContainerRuntime:
         compression_threshold: Optional[int] = DEFAULT_COMPRESSION_THRESHOLD,
         chunk_size: Optional[int] = DEFAULT_CHUNK_SIZE,
         gc_options: Optional[GCOptions] = None,
+        channel_types: Optional[Dict[str, Callable[[str], SharedObject]]] = None,
     ):
         """Connect and catch up to head before becoming interactive
         (reference Container.load, container.ts:300: snapshot + delta replay
@@ -89,6 +90,22 @@ class ContainerRuntime:
         # non-root ones live only while a handle somewhere references them.
         self.gc = GarbageCollector(gc_options)
         self._root_ids: set = set()
+        # Dynamic-channel machinery (reference datastore attach ops): a type
+        # registry lets remote/loading clients reconstruct channels minted at
+        # runtime; _channel_types records what to put in summaries.
+        self.channel_factories: Dict[str, Callable[[str], SharedObject]] = dict(
+            channel_types or {}
+        )
+        self._channel_types: Dict[str, str] = {}
+        # Attaches not yet seen sequenced: resent on reconnect/nack recovery
+        # (they live outside the op outbox, so pending-state replay alone
+        # would lose them).
+        self._pending_attaches: Dict[str, str] = {}
+        # Channels we couldn't realize (type missing from the registry):
+        # ops to them are an error and their summaries carry forward verbatim
+        # — silently dropping them would erase data for capable clients.
+        self._unrealized: Dict[str, str] = {}
+        self._carried_summaries: Dict[str, dict] = {}
         for ch in channels:
             self.create_channel(ch)
         if self.connection.initial_summary is not None:
@@ -107,6 +124,63 @@ class ContainerRuntime:
         if root:
             self._root_ids.add(channel.id)
         return channel
+
+    def register_channel_type(
+        self, type_name: str, ctor: Callable[[str], SharedObject]
+    ) -> None:
+        """Register a constructible channel type so this client can realize
+        channels other clients attach dynamically (and load them from
+        summaries)."""
+        self.channel_factories[type_name] = ctor
+
+    def attach_channel(
+        self, channel: SharedObject, type_name: str, root: bool = False
+    ) -> SharedObject:
+        """Create a channel at runtime and replicate its existence via an
+        ATTACH op (reference datastore attach): remote clients construct it
+        from the type registry, so ops on it have a target everywhere. The
+        attach stays in pending-attach state until seen sequenced, so
+        disconnection or a nack in between resubmits it."""
+        assert type_name in self.channel_factories, f"unregistered type {type_name}"
+        self.create_channel(channel, root=root)
+        self._channel_types[channel.id] = (type_name, root)
+        self._pending_attaches[channel.id] = (type_name, root)
+        if self.connected:
+            self._send_attach(channel.id, type_name, root)
+        return channel
+
+    def _send_attach(self, cid: str, type_name: str, root: bool) -> None:
+        self.client_seq += 1
+        self.connection.submit(
+            DocumentMessage(
+                client_sequence_number=self.client_seq,
+                reference_sequence_number=self.ref_seq,
+                type=MessageType.ATTACH,
+                contents={"id": cid, "type": type_name, "root": root},
+            )
+        )
+
+    def _resend_pending_attaches(self) -> None:
+        """Re-announce unacked attaches before any channel-op resubmission —
+        the attach must sequence before the channel's ops on every replica.
+        Duplicate announcements are harmless (receivers skip known ids)."""
+        for cid, (type_name, root) in self._pending_attaches.items():
+            self._send_attach(cid, type_name, root)
+
+    def _realize_channel(self, cid: str, type_name: str, root: bool) -> bool:
+        """Construct a dynamically-created channel from the type registry,
+        with the creator's rootness (GC reachability must agree on every
+        replica). Unknown types are recorded as unrealized: their ops error
+        loudly and this client declines to summarize (a summary without them
+        would erase the channel for every capable client; the reference
+        keeps unrealized subtrees verbatim)."""
+        ctor = self.channel_factories.get(type_name)
+        if ctor is None:
+            self._unrealized[cid] = (type_name, root)
+            return False
+        self.create_channel(ctor(cid), root=root)
+        self._channel_types[cid] = (type_name, root)
+        return True
 
     def get_channel(self, channel_id: str) -> SharedObject:
         if self.gc.is_tombstoned(f"/{channel_id}"):
@@ -198,6 +272,7 @@ class ContainerRuntime:
             # Rejected clientSequenceNumbers are reused: the server's per-
             # client counter only advances on sequenced ops.
             self.client_seq = self._last_acked_cseq
+            self._resend_pending_attaches()
             tail = list(self.pending)
             self.pending.clear()
             for ch in self.channels.values():
@@ -245,6 +320,16 @@ class ContainerRuntime:
             for ch in self.channels.values():
                 ch.on_client_leave(msg.contents)
             self._check_proposals()
+        elif msg.type == MessageType.ATTACH:
+            # Dynamic channel creation: the attaching client already has it;
+            # everyone else constructs it from the registry. Sequencing the
+            # attach before any op on the channel guarantees a target exists
+            # on every replica.
+            cid, type_name = msg.contents["id"], msg.contents["type"]
+            if msg.client_id in self._my_ids:
+                self._pending_attaches.pop(cid, None)
+            if cid not in self.channels:
+                self._realize_channel(cid, type_name, msg.contents.get("root", False))
         elif msg.type == MessageType.PROPOSE:
             # Quorum proposal (reference protocol-base/src/quorum.ts): keyed
             # by its sequence number, approved once MSN reaches it (every
@@ -255,6 +340,11 @@ class ContainerRuntime:
         elif msg.type == MessageType.OPERATION:
             address = msg.contents["address"]
             inner = msg.contents["contents"]
+            assert address not in self._unrealized, (
+                f"op for channel {address!r} of unknown type "
+                f"{self._unrealized.get(address)!r} — register the type "
+                "before loading this document"
+            )
             local = msg.client_id in self._my_ids
             local_metadata = None
             if local:
@@ -311,6 +401,7 @@ class ContainerRuntime:
             ch.on_reconnect(self.client_id)
         offline, self._offline = self._offline, []
         self.process_incoming()  # catch up before rebasing
+        self._resend_pending_attaches()
         for ch in self.channels.values():
             ch.begin_resubmit()
         for channel_id, contents, local_metadata in offline:
@@ -375,16 +466,30 @@ class ContainerRuntime:
                     graph[child_route] = [route] + collect_handle_routes(sub_summary)
             else:
                 graph[route] = collect_handle_routes(summary)
-        return self.gc.collect(graph, [f"/{cid}" for cid in sorted(self._root_ids)])
+        # Carried (unrealized) channels still participate: their verbatim
+        # summaries may hold handles keeping other channels alive, and rooted
+        # ones must stay roots — reachability must agree across replicas
+        # whether or not this client can realize the type.
+        roots = set(self._root_ids)
+        for cid, carried in self._carried_summaries.items():
+            graph[f"/{cid}"] = collect_handle_routes(carried)
+            if self._unrealized.get(cid, (None, False))[1]:
+                roots.add(cid)
+        return self.gc.collect(graph, [f"/{cid}" for cid in sorted(roots)])
 
     def summarize(self) -> dict:
         """Full summary: channel trees + protocol state (quorum, proposals)
         — the ``.protocol`` tree of the reference's client summary — plus
         the ``gc`` tree (unreferenced-node tracking, D.3). Swept routes are
         excluded, so future loads never resurrect them."""
+        assert not (set(self._unrealized) - set(self._carried_summaries)), (
+            "cannot summarize with op-attached channels of unknown type "
+            f"{self._unrealized!r}: the summary would erase them"
+        )
         channel_summaries = {
             cid: ch.summarize_core() for cid, ch in self.channels.items()
         }
+        channel_summaries.update(self._carried_summaries)
         gc_result = self.run_gc(channel_summaries)
         for route in gc_result.swept:
             cid = route.lstrip("/").split("/", 1)[0]
@@ -399,6 +504,11 @@ class ContainerRuntime:
             },
             "approved": dict(self.approved_proposals),
             "channels": channel_summaries,
+            "channel_types": {
+                cid: t
+                for cid, t in {**self._channel_types, **self._unrealized}.items()
+                if cid in channel_summaries
+            },
             "gc": self.gc.summarize(),
         }
 
@@ -406,6 +516,16 @@ class ContainerRuntime:
         handle, seq = initial
         summary = self._service.store.get_summary(handle)
         assert summary["sequence_number"] == seq
+        # Dynamically attached channels are reconstructed from their recorded
+        # (type, root) before their summaries load (their ATTACH op is below
+        # the summary seq, so replay won't recreate them). Unknown types keep
+        # their summary verbatim so a future summary by this client carries
+        # them forward instead of erasing them.
+        for cid, (type_name, root) in summary.get("channel_types", {}).items():
+            if cid not in self.channels and not self._realize_channel(
+                cid, type_name, root
+            ):
+                self._carried_summaries[cid] = summary["channels"][cid]
         for cid, channel_summary in summary["channels"].items():
             if cid in self.channels:
                 self.channels[cid].load_core(channel_summary)
@@ -459,6 +579,9 @@ class ContainerRuntime:
             and self.is_summarizer
             and not self.pending
             and not self._outbox
+            # Decline (don't crash op processing) while holding op-attached
+            # channels of unknown type: our summary would erase them.
+            and not (set(self._unrealized) - set(self._carried_summaries))
             and self.ref_seq - self.last_summary_seq >= self.summary_interval
         ):
             self.submit_summary()

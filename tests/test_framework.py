@@ -1,0 +1,220 @@
+"""Framework layer: fluid-static schema containers, client facade, aqueduct.
+
+Mirrors the reference's fluid-static/azure-client/aqueduct test shapes:
+schema round-trips through create/load, dynamic objects live and die by
+handle reachability, data-object lifecycle hooks fire on the right clients.
+"""
+
+from fluidframework_tpu.drivers.local_driver import LocalDocumentServiceFactory
+from fluidframework_tpu.framework.client import TpuClientProps, TpuFluidClient
+from fluidframework_tpu.framework.data_object import (
+    ContainerRuntimeFactoryWithDefaultDataStore,
+    DataObject,
+    DataObjectFactory,
+)
+from fluidframework_tpu.framework.fluid_static import ContainerSchema
+from fluidframework_tpu.models.shared_cell import SharedCell
+from fluidframework_tpu.models.shared_counter import SharedCounter
+from fluidframework_tpu.models.shared_map import SharedMap
+from fluidframework_tpu.models.shared_string import SharedString
+
+SCHEMA = ContainerSchema(
+    initial_objects={
+        "map": SharedMap,
+        "text": SharedString,
+        "count": SharedCounter,
+    },
+    dynamic_object_types=(SharedCell,),
+)
+
+
+def make_client():
+    return TpuFluidClient(TpuClientProps(LocalDocumentServiceFactory()))
+
+
+def pump(*containers):
+    for c in containers:
+        c.runtime.flush()
+    for c in containers:
+        c.runtime.process_incoming()
+
+
+def test_create_container_initial_objects():
+    client = make_client()
+    container, doc_id = client.create_container(SCHEMA)
+    objs = container.initial_objects
+    assert set(objs) == {"map", "text", "count"}
+    objs["map"].set("k", 1)
+    objs["text"].insert_text(0, "hi")
+    pump(container)
+    assert objs["map"].get("k") == 1
+    assert objs["text"].get_text() == "hi"
+
+
+def test_two_clients_collaborate_via_schema():
+    client = make_client()
+    c1, doc_id = client.create_container(SCHEMA)
+    c1.initial_objects["map"].set("who", "c1")
+    c1.initial_objects["text"].insert_text(0, "hello")
+    pump(c1)
+
+    c2 = client.get_container(doc_id, SCHEMA)
+    assert c2.initial_objects["map"].get("who") == "c1"
+    assert c2.initial_objects["text"].get_text() == "hello"
+    c2.initial_objects["text"].insert_text(5, " world")
+    pump(c2, c1)
+    assert c1.initial_objects["text"].get_text() == "hello world"
+    assert set(c1.audience) == set(c2.audience)
+    assert len(c1.audience) == 2
+
+
+def test_dynamic_object_create_and_handle_roundtrip():
+    client = make_client()
+    c1, doc_id = client.create_container(SCHEMA)
+    cell = c1.create(SharedCell)
+    cell.set("payload")
+    c1.initial_objects["map"].set("cell", c1.handle_of(cell))
+    pump(c1)
+    resolved = c1.resolve_handle(c1.initial_objects["map"].get("cell"))
+    assert resolved is cell
+    # Referenced by a rooted map -> survives GC.
+    result = c1.runtime.run_gc()
+    assert f"/{cell.id}" not in result.unreferenced
+
+
+def test_dynamic_object_unreferenced_is_gc_candidate():
+    client = make_client()
+    c1, _ = client.create_container(SCHEMA)
+    cell = c1.create(SharedCell)
+    cell.set("orphan")
+    pump(c1)
+    result = c1.runtime.run_gc()
+    assert f"/{cell.id}" in result.unreferenced
+
+
+def test_schema_mismatch_create_rejected():
+    import pytest
+
+    client = make_client()
+    c1, _ = client.create_container(SCHEMA)
+    with pytest.raises(AssertionError):
+        c1.create(SharedMap)  # not in dynamic_object_types
+
+
+def test_unknown_container_id_rejected():
+    import pytest
+
+    client = make_client()
+    with pytest.raises(AssertionError):
+        client.get_container("no-such-doc", SCHEMA)
+
+
+def test_dynamic_object_replicates_to_other_clients():
+    client = make_client()
+    c1, doc_id = client.create_container(SCHEMA)
+    cell = c1.create(SharedCell)
+    cell.set("shared-payload")
+    c1.initial_objects["map"].set("cell", c1.handle_of(cell))
+    pump(c1)
+
+    # A client that loads later replays the ATTACH op and realizes the cell.
+    c2 = client.get_container(doc_id, SCHEMA)
+    remote_cell = c2.resolve_handle(c2.initial_objects["map"].get("cell"))
+    assert remote_cell.get() == "shared-payload"
+    remote_cell.set("updated")
+    pump(c2, c1)
+    assert cell.get() == "updated"
+
+
+def test_dynamic_object_created_while_disconnected_replicates():
+    client = make_client()
+    c1, doc_id = client.create_container(SCHEMA)
+    pump(c1)
+    c1.disconnect()
+    cell = c1.create(SharedCell)  # ATTACH buffered, not submitted
+    cell.set("offline-made")
+    c1.initial_objects["map"].set("cell", c1.handle_of(cell))
+    c1.runtime.flush()
+    c1.connect()  # resends the attach, then the offline ops
+    pump(c1)
+
+    c2 = client.get_container(doc_id, SCHEMA)
+    remote = c2.resolve_handle(c2.initial_objects["map"].get("cell"))
+    assert remote.get() == "offline-made"
+
+
+def test_dynamic_object_survives_summary_load():
+    client = make_client()
+    c1, doc_id = client.create_container(SCHEMA)
+    cell = c1.create(SharedCell)
+    cell.set("persisted")
+    c1.initial_objects["map"].set("cell", c1.handle_of(cell))
+    pump(c1)
+    c1.runtime.submit_summary()
+    pump(c1)
+
+    # Summary-loaded client reconstructs the dynamic channel from its
+    # recorded type, without replaying the ATTACH op.
+    c3 = client.get_container(doc_id, SCHEMA)
+    # Catch-up started at the summary seq (the ATTACH op is below it and was
+    # not replayed), then advanced over the ack + c3's own join.
+    assert c3.runtime.ref_seq >= c1.runtime.last_summary_seq > 0
+    cell3 = c3.resolve_handle(c3.initial_objects["map"].get("cell"))
+    assert cell3.get() == "persisted"
+
+
+class Counter(DataObject):
+    """Tiny aqueduct-style data object."""
+
+    def initializing_first_time(self, props=None) -> None:
+        self.root.set("value", props or 0)
+
+    def initializing_from_existing(self) -> None:
+        assert self.root.has("value")
+
+    def increment(self) -> None:
+        self.root.set("value", self.value + 1)
+
+    @property
+    def value(self) -> int:
+        return self.root.get("value")
+
+
+def test_data_object_lifecycle_and_collab():
+    from fluidframework_tpu.service.local_server import LocalFluidService
+
+    service = LocalFluidService()
+    factory = ContainerRuntimeFactoryWithDefaultDataStore(
+        DataObjectFactory("counter", Counter)
+    )
+    rt1, obj1 = factory.instantiate(service, "doc-a", existing=False, props=10)
+    assert obj1.value == 10
+    obj1.increment()
+    rt1.flush()
+    rt1.process_incoming()
+
+    rt2, obj2 = factory.instantiate(service, "doc-a", existing=True)
+    assert obj2.value == 11
+    obj2.increment()
+    rt2.flush()
+    rt2.process_incoming()
+    rt1.process_incoming()
+    assert obj1.value == 12 and obj2.value == 12
+
+
+def test_dynamic_data_object_via_registry():
+    from fluidframework_tpu.service.local_server import LocalFluidService
+
+    service = LocalFluidService()
+    factory = ContainerRuntimeFactoryWithDefaultDataStore(
+        DataObjectFactory("counter", Counter)
+    )
+    rt1, obj1 = factory.instantiate(service, "doc-b", existing=False, props=0)
+    extra = factory.create_data_object(rt1, "counter", "extra", props=100)
+    extra.increment()
+    rt1.flush()
+    rt1.process_incoming()
+
+    rt2, _ = factory.instantiate(service, "doc-b", existing=True)
+    remote = factory.get_data_object(rt2, "extra")
+    assert remote.value == 101
