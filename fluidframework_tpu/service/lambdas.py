@@ -35,6 +35,7 @@ from fluidframework_tpu.protocol.types import (
     SequencedDocumentMessage,
 )
 from fluidframework_tpu.service.queue import PartitionedLog
+from fluidframework_tpu.telemetry import LumberEventName, Lumberjack
 from fluidframework_tpu.service.sequencer import (
     DocumentSequencer,
     SequencerCheckpoint,
@@ -199,6 +200,19 @@ class DeliDocLambda(PartitionLambda):
 
     def handler(self, key: str, value: dict) -> List[Tuple[str, str, Any]]:
         t = value["t"]
+        metric = Lumberjack.new_metric(
+            LumberEventName.DeliHandler,
+            {"tenantId": "local", "documentId": self.doc_id, "recordType": t},
+        )
+        try:
+            out = self._handle(key, value, t)
+        except Exception as e:  # pragma: no cover - defensive
+            metric.error("deli handler failed", e)
+            raise
+        metric.success()
+        return out
+
+    def _handle(self, key: str, value: dict, t: str) -> List[Tuple[str, str, Any]]:
         out: List[Tuple[str, str, Any]] = []
         if t == "join":
             res = self.sequencer.join(value.get("mode", "write"))
@@ -280,6 +294,11 @@ class ScribeDocLambda(PartitionLambda):
         self._decided.add(msg.sequence_number)
         handle = msg.contents["handle"]
         head = msg.contents["head"]
+        m = Lumberjack.new_metric(
+            LumberEventName.SummaryWrite,
+            {"tenantId": "local", "documentId": self.doc_id,
+             "summarySequenceNumber": msg.sequence_number},
+        )
         ok = (
             msg.reference_sequence_number >= self.protocol_head
             and self.store.has(handle)
@@ -287,6 +306,9 @@ class ScribeDocLambda(PartitionLambda):
         if ok:
             self.latest_summary = (handle, head)
             self.protocol_head = msg.sequence_number
+            m.success()
+        else:
+            m.error("summary nacked")
         return [
             (RAW_TOPIC, key,
              {"t": "summary_decision", "ok": ok, "handle": handle,

@@ -22,6 +22,7 @@ from fluidframework_tpu.protocol.types import (
 )
 from fluidframework_tpu.service.sequencer import DocumentSequencer
 from fluidframework_tpu.service.summary_store import SummaryStore
+from fluidframework_tpu.telemetry import tracing
 
 
 @dataclass
@@ -72,9 +73,18 @@ class LocalFluidService:
     + summary storage (ordering, scriptorium, broadcaster, and scribe roles
     of the reference pipeline, in one process)."""
 
-    def __init__(self, store: Optional[SummaryStore] = None) -> None:
+    def __init__(
+        self,
+        store: Optional[SummaryStore] = None,
+        messages_per_trace: int = 0,
+    ) -> None:
         self.docs: Dict[str, _DocState] = {}
         self.store = store or SummaryStore()
+        # Sampled op tracing at the front door (alfred stamps 1-in-N,
+        # reference config.json:58 numberOfMessagesPerTrace; 0 = off).
+        self.trace_sampler = (
+            tracing.TraceSampler(messages_per_trace) if messages_per_trace else None
+        )
 
     def _doc(self, doc_id: str) -> _DocState:
         if doc_id not in self.docs:
@@ -116,6 +126,8 @@ class LocalFluidService:
 
     def submit(self, doc_id: str, client_id: int, msg: DocumentMessage) -> None:
         doc = self._doc(doc_id)
+        if self.trace_sampler is not None and self.trace_sampler.should_trace():
+            tracing.stamp(msg.traces, "alfred", "start")
         res = doc.sequencer.ticket(client_id, msg)
         if res is None:
             return  # duplicate, dropped

@@ -38,6 +38,7 @@ from fluidframework_tpu.service.lambdas import (
 )
 from fluidframework_tpu.service.queue import PartitionedLog
 from fluidframework_tpu.service.summary_store import SummaryStore
+from fluidframework_tpu.telemetry import tracing
 
 
 class PipelineConnection:
@@ -76,10 +77,20 @@ class PipelineConnection:
 class PipelineFluidService:
     """Front door + lambda pipeline (alfred + localOrderer equivalent)."""
 
-    def __init__(self, n_partitions: int = 4, checkpoint_every: int = 10):
+    def __init__(
+        self,
+        n_partitions: int = 4,
+        checkpoint_every: int = 10,
+        messages_per_trace: int = 0,
+    ):
         self.log = PartitionedLog(n_partitions)
         self.store = SummaryStore()
         self.checkpoints = CheckpointStore()
+        # Sampled op tracing at the front door (alfred stamps 1-in-N,
+        # reference config.json:58 numberOfMessagesPerTrace; 0 = off).
+        self.trace_sampler = (
+            tracing.TraceSampler(messages_per_trace) if messages_per_trace else None
+        )
         self.ops_store: Dict[str, Dict[int, SequencedDocumentMessage]] = {}
         self.rooms: Dict[str, list] = {}
         self._token_counter = itertools.count(1)
@@ -200,6 +211,8 @@ class PipelineFluidService:
         self.pump()
 
     def submit(self, doc_id: str, client_id: int, msg: DocumentMessage) -> None:
+        if self.trace_sampler is not None and self.trace_sampler.should_trace():
+            tracing.stamp(msg.traces, "alfred", "start")
         self.log.send(
             RAW_TOPIC, doc_id, {"t": "op", "client": client_id, "msg": msg}
         )

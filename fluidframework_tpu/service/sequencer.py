@@ -22,6 +22,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Union
 
 from fluidframework_tpu.protocol.constants import MAX_WRITERS
+from fluidframework_tpu.telemetry import tracing
 from fluidframework_tpu.protocol.types import (
     DocumentMessage,
     MessageType,
@@ -136,11 +137,20 @@ class DocumentSequencer:
         entry.client_seq = msg.client_sequence_number
         entry.ref_seq = msg.reference_sequence_number
 
+        # Sampled op tracing: if the front door stamped this message, the
+        # sequencer appends its own span (reference deli/lambda.ts:1451).
+        # Stamps go on a copy — the inbound message stays caller-owned.
+        traces = list(msg.traces)
+        if traces:
+            tracing.stamp(traces, "deli", "start")
+
         # Unlike the reference (deli lambda.ts:896-927 leaves NoOps
         # un-sequenced and coalesces them), NOOPs here consume a sequence
         # number like any op: clients then see a strictly gapless stream,
         # which keeps the device-side scan and the dedup rules uniform.
         self.seq += 1
+        if traces:
+            tracing.stamp(traces, "deli", "end")
         return SequencedDocumentMessage(
             client_id=client_id,
             sequence_number=self.seq,
@@ -151,7 +161,7 @@ class DocumentSequencer:
             contents=msg.contents,
             metadata=msg.metadata,
             timestamp=time.time(),
-            traces=list(msg.traces),
+            traces=traces,
         )
 
     # -- internals ------------------------------------------------------------

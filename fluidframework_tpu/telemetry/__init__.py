@@ -1,0 +1,43 @@
+"""Telemetry, config/feature gates, and op tracing.
+
+TPU-native counterpart of the reference's two telemetry stacks:
+``packages/utils/telemetry-utils`` (client: ChildLogger, PerformanceEvent,
+MonitoringContext + feature gates) and
+``server/routerlicious/packages/services-telemetry`` (server: Lumberjack
+structured metrics), plus the wire-level ``ITrace`` op stamps of
+``protocol-definitions/src/protocol.ts:173``.
+"""
+
+from fluidframework_tpu.telemetry.config import (
+    ConfigProvider,
+    LayeredConfig,
+    MonitoringContext,
+)
+from fluidframework_tpu.telemetry.logger import (
+    ChildLogger,
+    CollectingLogger,
+    PerformanceEvent,
+    TelemetryLogger,
+)
+from fluidframework_tpu.telemetry.lumberjack import (
+    CollectingEngine,
+    Lumber,
+    LumberEventName,
+    Lumberjack,
+)
+from fluidframework_tpu.telemetry import tracing
+
+__all__ = [
+    "ChildLogger",
+    "CollectingEngine",
+    "CollectingLogger",
+    "ConfigProvider",
+    "LayeredConfig",
+    "Lumber",
+    "LumberEventName",
+    "Lumberjack",
+    "MonitoringContext",
+    "PerformanceEvent",
+    "TelemetryLogger",
+    "tracing",
+]

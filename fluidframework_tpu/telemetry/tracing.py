@@ -1,0 +1,65 @@
+"""Wire-level op traces: per-op end-to-end latency decomposition.
+
+Reference: ``ITrace[]`` rides on every message
+(``protocol-definitions/src/protocol.ts:173,279``); alfred stamps 1-in-N
+messages on receipt (``config.json:58`` ``numberOfMessagesPerTrace``), deli
+appends ``{service:"deli", action:"start|end", timestamp}``
+(``deli/lambda.ts:1451``), and clients can echo the trace back, giving a
+per-op pipeline latency breakdown with zero steady-state cost (untraced
+messages carry an empty list).
+
+Traces are plain ``(service, action, timestamp)`` tuples kept as dicts for
+wire fidelity.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+
+def stamp(traces: List[dict], service: str, action: str, timestamp: Optional[float] = None) -> None:
+    """Append one trace entry in place (reference ``ITrace``)."""
+    traces.append(
+        {
+            "service": service,
+            "action": action,
+            "timestamp": time.time() if timestamp is None else timestamp,
+        }
+    )
+
+
+class TraceSampler:
+    """1-in-N sampling gate (alfred's ``numberOfMessagesPerTrace``).
+
+    ``should_trace()`` is called per inbound message; when it fires, the
+    ingress stamps ``start`` and every later stage appends its own stamps
+    only if the message already carries a non-empty trace list — so the
+    sampling decision is made exactly once at the front door.
+    """
+
+    def __init__(self, messages_per_trace: int = 100):
+        self.messages_per_trace = max(1, int(messages_per_trace))
+        self._count = 0
+
+    def should_trace(self) -> bool:
+        self._count += 1
+        return self._count % self.messages_per_trace == 0
+
+
+def spans(traces: List[dict]) -> Dict[str, float]:
+    """Reduce a trace list to per-service durations in ms: for each service
+    with both ``start`` and ``end`` stamps, ``<service>_ms``; plus
+    ``total_ms`` from the first to the last stamp."""
+    if not traces:
+        return {}
+    by_service: Dict[str, Dict[str, float]] = {}
+    for t in traces:
+        by_service.setdefault(t["service"], {})[t["action"]] = t["timestamp"]
+    out: Dict[str, float] = {}
+    for svc, acts in by_service.items():
+        if "start" in acts and "end" in acts:
+            out[f"{svc}_ms"] = (acts["end"] - acts["start"]) * 1e3
+    ts = [t["timestamp"] for t in traces]
+    out["total_ms"] = (max(ts) - min(ts)) * 1e3
+    return out

@@ -1,0 +1,259 @@
+"""Telemetry, config/feature gates, Lumberjack, and op tracing.
+
+Covers the reference's two telemetry stacks (telemetry-utils client side,
+services-telemetry server side) and the ITrace wire stamps (§5.1/5.5/5.6
+of SURVEY.md).
+"""
+
+import json
+
+import pytest
+
+from fluidframework_tpu.protocol.types import DocumentMessage, MessageType
+from fluidframework_tpu.service.local_server import LocalFluidService
+from fluidframework_tpu.telemetry import (
+    ChildLogger,
+    CollectingEngine,
+    CollectingLogger,
+    ConfigProvider,
+    LayeredConfig,
+    LumberEventName,
+    Lumberjack,
+    MonitoringContext,
+    PerformanceEvent,
+    tracing,
+)
+
+
+# ---------------------------------------------------------------------------
+# Client logger
+
+
+def test_child_logger_namespacing():
+    root = CollectingLogger(properties={"containerId": "c1"})
+    child = ChildLogger.create(root, "fluid:telemetry")
+    grandchild = ChildLogger.create(child, "DeltaManager")
+    grandchild.send({"eventName": "ConnectionStateChange", "state": "connected"})
+    [evt] = root.events
+    assert evt["eventName"] == "fluid:telemetry:DeltaManager:ConnectionStateChange"
+    assert evt["containerId"] == "c1"  # common properties flow down
+    assert evt["state"] == "connected"
+
+
+def test_error_event():
+    log = CollectingLogger()
+    try:
+        raise ValueError("boom")
+    except ValueError as e:
+        log.send_error("OpProcessingError", e, sequenceNumber=7)
+    [evt] = log.events
+    assert evt["category"] == "error"
+    assert evt["errorType"] == "ValueError"
+    assert evt["sequenceNumber"] == 7
+
+
+def test_performance_event_end_and_cancel():
+    log = CollectingLogger()
+    with PerformanceEvent(log, "Summarize", emit_start=True, attempt=1):
+        pass
+    assert [e["eventName"] for e in log.events] == [
+        "Summarize_start",
+        "Summarize_end",
+    ]
+    assert log.events[1]["duration"] >= 0
+
+    log2 = CollectingLogger()
+    with pytest.raises(RuntimeError):
+        with PerformanceEvent(log2, "Summarize"):
+            raise RuntimeError("nope")
+    [evt] = log2.events
+    assert evt["eventName"] == "Summarize_cancel"
+    assert evt["error"] == "nope"
+
+
+# ---------------------------------------------------------------------------
+# Config / feature gates
+
+
+def test_config_provider_coercion():
+    cfg = ConfigProvider(
+        {
+            "Fluid.Enable": True,
+            "Fluid.EnableStr": "true",
+            "Fluid.MaxOps": 500,
+            "Fluid.MaxOpsStr": "500",
+            "Fluid.Name": "prod",
+        }
+    )
+    assert cfg.get_boolean("Fluid.Enable") is True
+    assert cfg.get_boolean("Fluid.EnableStr") is True
+    assert cfg.get_boolean("Fluid.Missing", False) is False
+    assert cfg.get_boolean("Fluid.Name") is None  # wrong type -> default
+    assert cfg.get_number("Fluid.MaxOps") == 500
+    assert cfg.get_number("Fluid.MaxOpsStr") == 500.0
+    assert cfg.get_number("Fluid.Enable") is None  # bools are not numbers
+    assert cfg.get_string("Fluid.Name") == "prod"
+
+
+def test_monitoring_context_bundles():
+    mc = MonitoringContext(CollectingLogger(), ConfigProvider({"gate": True}))
+    if mc.config.get_boolean("gate"):
+        mc.logger.send({"eventName": "gated"})
+    assert mc.logger.events
+
+
+def test_layered_config_precedence(tmp_path):
+    base = {"deli": {"checkpointHeuristics": {"maxMessages": 500}}, "port": 3000}
+    p = tmp_path / "config.json"
+    p.write_text(json.dumps(base))
+    cfg = LayeredConfig.from_json_file(str(p), {"port": 4000})
+    assert cfg.get("port") == 4000  # override layer wins
+    assert cfg.get("deli:checkpointHeuristics:maxMessages") == 500
+    assert cfg.get("deli:missing", "d") == "d"
+    cfg.set("deli:enableNackMessages", False)
+    assert cfg.get("deli:enableNackMessages") is False
+
+
+# ---------------------------------------------------------------------------
+# Lumberjack
+
+
+def test_lumber_metric_success_and_schema():
+    eng = CollectingEngine()
+    Lumberjack.setup([eng])
+    try:
+        m = Lumberjack.new_metric(
+            LumberEventName.DeliHandler, {"tenantId": "t", "documentId": "d"}
+        )
+        m.set_property("sequenceNumber", 12)
+        m.success("sequenced")
+        [rec] = eng.records
+        assert rec["successful"] is True
+        assert rec["durationInMs"] >= 0
+        assert "schemaValidationFailed" not in rec
+
+        # Missing required property -> flagged, not thrown.
+        m2 = Lumberjack.new_metric(LumberEventName.DeliHandler, {"tenantId": "t"})
+        m2.error("bad")
+        assert eng.records[-1]["schemaValidationFailed"] == ["documentId"]
+
+        # Double completion raises.
+        with pytest.raises(RuntimeError):
+            m.success()
+    finally:
+        Lumberjack.reset()
+
+
+def test_lambda_pipeline_emits_deli_metrics():
+    from fluidframework_tpu.service.pipeline import PipelineFluidService
+
+    eng = CollectingEngine()
+    Lumberjack.setup([eng])
+    try:
+        svc = PipelineFluidService()
+        conn = svc.connect("doc1")
+        conn.submit(
+            DocumentMessage(
+                client_sequence_number=1,
+                reference_sequence_number=0,
+                type=MessageType.OPERATION,
+                contents={"k": "v"},
+            )
+        )
+        deli = eng.matches(LumberEventName.DeliHandler)
+        assert len(deli) >= 2  # join + op
+        assert all(r["successful"] for r in deli)
+        assert deli[0]["properties"]["documentId"] == "doc1"
+    finally:
+        Lumberjack.reset()
+
+
+# ---------------------------------------------------------------------------
+# Op traces
+
+
+def test_trace_sampler_and_spans():
+    s = tracing.TraceSampler(3)
+    fired = [s.should_trace() for _ in range(9)]
+    assert fired == [False, False, True] * 3
+
+    traces: list = []
+    tracing.stamp(traces, "alfred", "start", 1.0)
+    tracing.stamp(traces, "deli", "start", 1.01)
+    tracing.stamp(traces, "deli", "end", 1.05)
+    sp = tracing.spans(traces)
+    assert sp["deli_ms"] == pytest.approx(40.0, abs=1e-6)
+    assert sp["total_ms"] == pytest.approx(50.0, abs=1e-6)
+    assert tracing.spans([]) == {}
+
+
+def test_traced_op_through_service():
+    svc = LocalFluidService(messages_per_trace=1)  # trace every op
+    conn = svc.connect("doc")
+    join_seq = conn.take_inbox()[-1].sequence_number
+    conn.submit(
+        DocumentMessage(
+            client_sequence_number=1,
+            reference_sequence_number=join_seq,
+            type=MessageType.OPERATION,
+            contents={"x": 1},
+        )
+    )
+    seq = [m for m in conn.take_inbox() if m.type == MessageType.OPERATION]
+    [msg] = seq
+    services = [(t["service"], t["action"]) for t in msg.traces]
+    assert ("alfred", "start") in services
+    assert ("deli", "start") in services and ("deli", "end") in services
+    assert tracing.spans(msg.traces)["deli_ms"] >= 0
+
+
+def test_traced_op_through_lambda_pipeline():
+    from fluidframework_tpu.service.pipeline import PipelineFluidService
+
+    svc = PipelineFluidService(messages_per_trace=1)
+    conn = svc.connect("doc")
+    join_seq = conn.take_inbox()[-1].sequence_number
+    conn.submit(
+        DocumentMessage(
+            client_sequence_number=1,
+            reference_sequence_number=join_seq,
+            type=MessageType.OPERATION,
+            contents={"x": 1},
+        )
+    )
+    [msg] = [m for m in conn.take_inbox() if m.type == MessageType.OPERATION]
+    services = [(t["service"], t["action"]) for t in msg.traces]
+    assert ("alfred", "start") in services
+    assert ("deli", "start") in services and ("deli", "end") in services
+
+
+def test_inbound_message_not_mutated_by_sequencer():
+    """Server-side stamps must land on the sequenced copy only — the
+    client-owned DocumentMessage keeps exactly its front-door stamps."""
+    svc = LocalFluidService(messages_per_trace=1)
+    conn = svc.connect("doc")
+    join_seq = conn.take_inbox()[-1].sequence_number
+    msg = DocumentMessage(
+        client_sequence_number=1,
+        reference_sequence_number=join_seq,
+        type=MessageType.OPERATION,
+        contents={"x": 1},
+    )
+    conn.submit(msg)
+    assert [t["service"] for t in msg.traces] == ["alfred"]
+
+
+def test_untraced_ops_carry_no_traces():
+    svc = LocalFluidService()  # sampling off
+    conn = svc.connect("doc")
+    join_seq = conn.take_inbox()[-1].sequence_number
+    conn.submit(
+        DocumentMessage(
+            client_sequence_number=1,
+            reference_sequence_number=join_seq,
+            type=MessageType.OPERATION,
+            contents={"x": 1},
+        )
+    )
+    [msg] = [m for m in conn.take_inbox() if m.type == MessageType.OPERATION]
+    assert msg.traces == []
