@@ -42,19 +42,27 @@ class SharedMap(SharedObject):
     # -- local edits ----------------------------------------------------------
 
     def set(self, key: str, value: Any) -> None:
+        prev = self._data.get(key)
         self._data[key] = value
         self._pending[key] = self._pending.get(key, 0) + 1
         self.submit_local_message({"k": "set", "key": key, "val": value})
+        # valueChanged fires at the point of visible change — optimistically
+        # for local edits (reference map.ts IValueChanged events).
+        self.emit("valueChanged", {"key": key, "previousValue": prev}, True)
 
     def delete(self, key: str) -> None:
-        self._data.pop(key, None)
+        existed = key in self._data
+        prev = self._data.pop(key, None)
         self._pending[key] = self._pending.get(key, 0) + 1
         self.submit_local_message({"k": "del", "key": key})
+        if existed:  # deleting an absent key changes nothing visible
+            self.emit("valueChanged", {"key": key, "previousValue": prev}, True)
 
     def clear(self) -> None:
         self._data.clear()
         self._pending["\0clear"] = self._pending.get("\0clear", 0) + 1
         self.submit_local_message({"k": "clear"})
+        self.emit("clear", True)
 
     # -- sequenced stream -----------------------------------------------------
 
@@ -79,6 +87,7 @@ class SharedMap(SharedObject):
             self._data = {
                 k: v for k, v in self._data.items() if self._pending.get(k, 0) > 0
             }
+            self.emit("clear", False)
             return
         key = c["key"]
         if self._pending.get("\0clear", 0) > 0:
@@ -89,10 +98,15 @@ class SharedMap(SharedObject):
             return
         if self._pending.get(key, 0) > 0:
             return  # local pending op on this key wins until acked
+        existed = key in self._data
+        prev = self._data.get(key)
         if c["k"] == "set":
             self._data[key] = c["val"]
         elif c["k"] == "del":
+            if not existed:
+                return  # nothing visible changed
             self._data.pop(key, None)
+        self.emit("valueChanged", {"key": key, "previousValue": prev}, False)
 
     # -- summary / load -------------------------------------------------------
 

@@ -40,6 +40,22 @@ from fluidframework_tpu.runtime.shared_object import SharedObject
 _ORIG_STRIDE = 1 << 20  # content ids: client_slot * stride + lseq
 
 
+def _delta_from_contents(c: dict) -> dict:
+    """Decode wire op contents to a delta dict — the single place the
+    SharedString wire keys are spelled out (consumed by both the kernel-row
+    lowering and remote sequenceDelta events)."""
+    if c["k"] == "ins":
+        return {"kind": "insert", "pos": c["pos"], "text": c["text"],
+                "orig": c["orig"]}
+    if c["k"] == "rem":
+        return {"kind": "remove", "start": c["start"], "end": c["end"],
+                "removed": None}
+    if c["k"] == "ann":
+        return {"kind": "annotate", "start": c["start"], "end": c["end"],
+                "val": c["val"], "previous": None}
+    raise ValueError(f"unknown SharedString op {c!r}")
+
+
 class SharedString(SharedObject):
     """Collaborative sequence of text with LWW annotations (single lane)."""
 
@@ -159,8 +175,20 @@ class SharedString(SharedObject):
             {"k": "ins", "pos": pos, "text": text, "orig": orig},
             {"kind": "insert", "lseq": self._lseq},
         )
+        self.emit(
+            "sequenceDelta",
+            {"kind": "insert", "pos": pos, "text": text, "orig": orig},
+            True,
+        )
 
     def remove_range(self, start: int, end: int) -> None:
+        # Removed text is only observable before the apply; capture it just
+        # for listeners (undo-redo needs it, reference SequenceDeltaEvent).
+        removed = (
+            self.get_text()[start:end]
+            if self.has_listeners("sequenceDelta")
+            else None
+        )
         self._lseq += 1
         row = E.remove(
             start, end, seq=UNASSIGNED_SEQ, client=self.client_id, lseq=self._lseq
@@ -170,10 +198,20 @@ class SharedString(SharedObject):
             {"k": "rem", "start": start, "end": end},
             {"kind": "remove", "lseq": self._lseq},
         )
+        self.emit(
+            "sequenceDelta",
+            {"kind": "remove", "start": start, "end": end, "removed": removed},
+            True,
+        )
 
     def annotate(self, start: int, end: int, value: int) -> None:
         """Annotate a range with an interned int value (LWW single lane;
         PropertySet-keyed annotation is layered host-side in round 2)."""
+        previous = (
+            self._annotation_runs_in(start, end)
+            if self.has_listeners("sequenceDelta")
+            else None
+        )
         self._lseq += 1
         row = E.annotate(
             start, end, value, seq=UNASSIGNED_SEQ,
@@ -184,6 +222,29 @@ class SharedString(SharedObject):
             {"k": "ann", "start": start, "end": end, "val": value},
             {"kind": "annotate", "lseq": self._lseq},
         )
+        self.emit(
+            "sequenceDelta",
+            {"kind": "annotate", "start": start, "end": end, "val": value,
+             "previous": previous},
+            True,
+        )
+
+    def _annotation_runs_in(self, start: int, end: int) -> list:
+        """[(s, e, value)] runs fully covering [start, end), value 0 for
+        unannotated gaps — the exact inverse data an undo needs."""
+        runs = []
+        pos = start
+        for s, e, v in self.annotations():
+            s, e = max(s, start), min(e, end)
+            if s >= e:
+                continue
+            if s > pos:
+                runs.append((pos, s, 0))
+            runs.append((s, e, v))
+            pos = e
+        if pos < end:
+            runs.append((pos, end, 0))
+        return runs
 
     # -- sequenced stream -----------------------------------------------------
 
@@ -212,7 +273,17 @@ class SharedString(SharedObject):
             )
         else:
             row = self._row_from_contents(msg)
+        remote_delta = None
+        if not local and self.has_listeners("sequenceDelta"):
+            # Remote coordinates are in the sender's (refSeq, client)
+            # perspective — resolving them against the local view is the
+            # kernel's job, so remote events carry op coordinates only
+            # (no removed-text/previous-value capture; undo-redo consumes
+            # local events exclusively).
+            remote_delta = _delta_from_contents(msg.contents)
         self._apply(row)
+        if remote_delta is not None:
+            self.emit("sequenceDelta", remote_delta, False)
         # Slide references eagerly once a removal is sequenced (A.9): the
         # remove just applied is acked, so anchors on it re-anchor before
         # compaction can reclaim the row.
@@ -223,21 +294,19 @@ class SharedString(SharedObject):
             self._normalize_refs()
 
     def _row_from_contents(self, msg: SequencedDocumentMessage) -> np.ndarray:
-        c = msg.contents
+        d = _delta_from_contents(msg.contents)
         common = dict(
             seq=msg.sequence_number,
             ref=msg.reference_sequence_number,
             client=msg.client_id,
             msn=msg.minimum_sequence_number,
         )
-        if c["k"] == "ins":
-            self._payloads[c["orig"]] = c["text"]
-            return E.insert(c["pos"], c["orig"], len(c["text"]), **common)
-        if c["k"] == "rem":
-            return E.remove(c["start"], c["end"], **common)
-        if c["k"] == "ann":
-            return E.annotate(c["start"], c["end"], c["val"], **common)
-        raise ValueError(f"unknown SharedString op {c!r}")
+        if d["kind"] == "insert":
+            self._payloads[d["orig"]] = d["text"]
+            return E.insert(d["pos"], d["orig"], len(d["text"]), **common)
+        if d["kind"] == "remove":
+            return E.remove(d["start"], d["end"], **common)
+        return E.annotate(d["start"], d["end"], d["val"], **common)
 
     def _apply(self, row: np.ndarray) -> None:
         self._state = jit_apply_ops(self._state, row[None, :].astype(np.int32))

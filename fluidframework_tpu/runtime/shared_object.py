@@ -13,12 +13,16 @@ import abc
 from typing import Any, Optional
 
 from fluidframework_tpu.protocol.types import SequencedDocumentMessage
+from fluidframework_tpu.utils.events import TypedEventEmitter
 
 
-class SharedObject(abc.ABC):
-    """Base class for all distributed data structures."""
+class SharedObject(TypedEventEmitter, abc.ABC):
+    """Base class for all distributed data structures. Also an event
+    emitter (reference SharedObjectCore extends TypedEventEmitter): DDSes
+    emit change events for views, undo-redo, and interception layers."""
 
     def __init__(self, channel_id: str):
+        super().__init__()
         self.id = channel_id
         self._runtime = None  # set on attach
 

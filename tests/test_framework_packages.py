@@ -1,0 +1,340 @@
+"""Framework packages: undo-redo, attributor, agent-scheduler, synthesize
+DI, and DDS interceptions (SURVEY §2.4)."""
+
+import pytest
+
+from fluidframework_tpu.framework.agent_scheduler import UNCLAIMED, AgentScheduler
+from fluidframework_tpu.framework.attributor import Attributor, mixin_attributor
+from fluidframework_tpu.framework.interceptions import (
+    create_shared_map_with_interception,
+    create_shared_string_with_interception,
+)
+from fluidframework_tpu.framework.synthesize import DependencyContainer
+from fluidframework_tpu.framework.undo_redo import (
+    SharedMapUndoRedoHandler,
+    SharedStringUndoRedoHandler,
+    UndoRedoStackManager,
+)
+from fluidframework_tpu.models.shared_map import SharedMap
+from fluidframework_tpu.models.shared_string import SharedString
+from fluidframework_tpu.protocol.types import MessageType
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service.local_server import LocalFluidService
+
+
+def make_pair(service, doc="doc", channels=()):
+    """Two connected runtimes sharing one document, given (ctor, id) pairs."""
+    outs = []
+    for _ in range(2):
+        rt = ContainerRuntime(
+            service, doc, channels=tuple(ctor(cid) for ctor, cid in channels)
+        )
+        outs.append((rt, [rt.channels[cid] for _, cid in channels]))
+    for rt, _ in outs:
+        rt.process_incoming()
+    return outs
+
+
+def pump(*runtimes):
+    for _ in range(4):
+        for rt in runtimes:
+            rt.process_incoming()
+
+
+# ---------------------------------------------------------------------------
+# Undo-redo: SharedMap
+
+
+def test_map_undo_redo_roundtrip():
+    svc = LocalFluidService()
+    (rt_a, [map_a]), (rt_b, [map_b]) = make_pair(
+        svc, channels=[(SharedMap, "m")]
+    )
+    stacks = UndoRedoStackManager()
+    SharedMapUndoRedoHandler(stacks).attach(map_a)
+
+    map_a.set("k", 1)
+    stacks.close_current_operation()
+    map_a.set("k", 2)
+    stacks.close_current_operation()
+    pump(rt_a, rt_b)
+    assert map_b.get("k") == 2
+
+    assert stacks.undo_operation()
+    pump(rt_a, rt_b)
+    assert map_a.get("k") == 1 and map_b.get("k") == 1
+
+    assert stacks.undo_operation()
+    pump(rt_a, rt_b)
+    assert not map_a.has("k") and not map_b.has("k")
+
+    assert stacks.redo_operation()
+    pump(rt_a, rt_b)
+    assert map_a.get("k") == 1 and map_b.get("k") == 1
+
+    assert stacks.redo_operation()
+    pump(rt_a, rt_b)
+    assert map_a.get("k") == 2 and map_b.get("k") == 2
+    assert not stacks.can_redo
+
+
+def test_map_fresh_edit_clears_redo():
+    svc = LocalFluidService()
+    (rt_a, [map_a]), _ = make_pair(svc, channels=[(SharedMap, "m")])
+    stacks = UndoRedoStackManager()
+    SharedMapUndoRedoHandler(stacks).attach(map_a)
+    map_a.set("k", 1)
+    stacks.close_current_operation()
+    stacks.undo_operation()
+    assert stacks.can_redo
+    map_a.set("k", 9)  # fresh edit invalidates the redo branch
+    stacks.close_current_operation()
+    assert not stacks.can_redo
+
+
+def test_operation_grouping_undoes_as_unit():
+    svc = LocalFluidService()
+    (rt_a, [map_a]), (rt_b, [map_b]) = make_pair(
+        svc, channels=[(SharedMap, "m")]
+    )
+    stacks = UndoRedoStackManager()
+    SharedMapUndoRedoHandler(stacks).attach(map_a)
+    map_a.set("x", 1)
+    map_a.set("y", 2)  # same group: no close between
+    stacks.close_current_operation()
+    stacks.undo_operation()
+    pump(rt_a, rt_b)
+    assert not map_a.has("x") and not map_a.has("y")
+    assert not map_b.has("x") and not map_b.has("y")
+
+
+def test_map_delete_absent_key_emits_nothing():
+    svc = LocalFluidService()
+    (rt_a, [map_a]), (rt_b, [map_b]) = make_pair(
+        svc, channels=[(SharedMap, "m")]
+    )
+    events_a, events_b = [], []
+    map_a.on("valueChanged", lambda ch, local: events_a.append(ch))
+    map_b.on("valueChanged", lambda ch, local: events_b.append(ch))
+    map_a.delete("ghost")  # no visible change anywhere
+    pump(rt_a, rt_b)
+    assert events_a == [] and events_b == []
+
+
+# ---------------------------------------------------------------------------
+# Undo-redo: SharedString
+
+
+def test_string_undo_insert_remove():
+    svc = LocalFluidService()
+    (rt_a, [str_a]), (rt_b, [str_b]) = make_pair(
+        svc, channels=[(SharedString, "s")]
+    )
+    stacks = UndoRedoStackManager()
+    SharedStringUndoRedoHandler(stacks).attach(str_a)
+
+    str_a.insert_text(0, "hello world")
+    stacks.close_current_operation()
+    str_a.remove_range(5, 11)
+    stacks.close_current_operation()
+    pump(rt_a, rt_b)
+    assert str_a.get_text() == "hello"
+
+    stacks.undo_operation()  # undo the remove: re-insert " world"
+    pump(rt_a, rt_b)
+    assert str_a.get_text() == "hello world"
+    assert str_b.get_text() == "hello world"
+
+    stacks.undo_operation()  # undo the insert
+    pump(rt_a, rt_b)
+    assert str_a.get_text() == " world"  # the re-inserted text is a new op
+    assert str_b.get_text() == " world"
+
+
+def test_string_undo_insert_survives_concurrent_remote_edit():
+    svc = LocalFluidService()
+    (rt_a, [str_a]), (rt_b, [str_b]) = make_pair(
+        svc, channels=[(SharedString, "s")]
+    )
+    stacks = UndoRedoStackManager()
+    SharedStringUndoRedoHandler(stacks).attach(str_a)
+
+    str_a.insert_text(0, "abc")
+    stacks.close_current_operation()
+    pump(rt_a, rt_b)
+    str_b.insert_text(1, "XY")  # b splits a's inserted run
+    pump(rt_a, rt_b)
+    assert str_a.get_text() == "aXYbc"
+
+    stacks.undo_operation()  # removes what remains of "abc", leaves "XY"
+    pump(rt_a, rt_b)
+    assert str_a.get_text() == "XY"
+    assert str_b.get_text() == "XY"
+
+
+def test_string_undo_annotate_restores_previous_runs():
+    svc = LocalFluidService()
+    (rt_a, [str_a]), (rt_b, [str_b]) = make_pair(
+        svc, channels=[(SharedString, "s")]
+    )
+    stacks = UndoRedoStackManager()
+    SharedStringUndoRedoHandler(stacks).attach(str_a)
+
+    str_a.insert_text(0, "abcdef")
+    stacks.close_current_operation()
+    str_a.annotate(0, 3, 7)
+    stacks.close_current_operation()
+    str_a.annotate(1, 5, 9)  # overwrites part of the first annotation
+    stacks.close_current_operation()
+    pump(rt_a, rt_b)
+
+    stacks.undo_operation()  # restore runs: [1,3)=7, [3,5)=0
+    pump(rt_a, rt_b)
+    assert str_a.annotations() == [(0, 3, 7)]
+    assert str_b.annotations() == [(0, 3, 7)]
+
+
+# ---------------------------------------------------------------------------
+# Attributor
+
+
+def test_op_stream_attributor_records_and_serializes():
+    svc = LocalFluidService()
+    (rt_a, [map_a]), (rt_b, [map_b]) = make_pair(
+        svc, channels=[(SharedMap, "m")]
+    )
+    attr_b = mixin_attributor(rt_b)
+    map_a.set("k", 1)
+    map_a.set("j", 2)
+    pump(rt_a, rt_b)
+
+    entries = attr_b.entries()
+    assert len(entries) == 2
+    seqs = sorted(entries)
+    client, ts = entries[seqs[0]]
+    assert client == rt_a.client_id
+    assert ts > 0
+    assert attr_b.user_of(seqs[0]) == f"client-{rt_a.client_id}"
+
+    # Round-trip through the delta-compressed summary encoding.
+    blob = Attributor.deserialize(attr_b.serialize())
+    assert blob.entries() == entries
+
+
+# ---------------------------------------------------------------------------
+# AgentScheduler
+
+
+def test_agent_scheduler_first_claim_wins():
+    svc = LocalFluidService()
+    (rt_a, [sch_a]), (rt_b, [sch_b]) = make_pair(
+        svc, channels=[(AgentScheduler, "sch")]
+    )
+    picked = []
+    sch_a.on("picked", picked.append)
+    sch_a.pick("leader")
+    sch_b.pick("leader")
+    pump(rt_a, rt_b)
+    assert sch_a.holder_of("leader") == rt_a.client_id
+    assert sch_b.holder_of("leader") == rt_a.client_id
+    assert picked == ["leader"]
+    assert sch_a.picked_tasks() == {"leader"}
+    assert sch_b.picked_tasks() == set()
+
+
+def test_agent_scheduler_reelection_on_leave():
+    svc = LocalFluidService()
+    (rt_a, [sch_a]), (rt_b, [sch_b]) = make_pair(
+        svc, channels=[(AgentScheduler, "sch")]
+    )
+    sch_a.pick("t")
+    sch_b.pick("t")
+    pump(rt_a, rt_b)
+    assert sch_b.holder_of("t") == rt_a.client_id
+
+    rt_a.dispose() if hasattr(rt_a, "dispose") else rt_a.disconnect()
+    pump(rt_b)
+    pump(rt_b)
+    assert sch_b.holder_of("t") == rt_b.client_id  # b re-elected
+
+
+def test_agent_scheduler_release():
+    svc = LocalFluidService()
+    (rt_a, [sch_a]), (rt_b, [sch_b]) = make_pair(
+        svc, channels=[(AgentScheduler, "sch")]
+    )
+    sch_a.pick("t")
+    pump(rt_a, rt_b)
+    sch_b.pick("t")  # b volunteers while a holds
+    pump(rt_a, rt_b)
+    lost = []
+    sch_a.on("lost", lost.append)
+    sch_a.release("t")
+    pump(rt_a, rt_b)
+    assert lost == ["t"]
+    # b re-volunteered on the sequenced release and won.
+    assert sch_a.holder_of("t") == rt_b.client_id
+    assert sch_b.picked_tasks() == {"t"}
+
+
+# ---------------------------------------------------------------------------
+# Synthesize DI
+
+
+def test_dependency_container_resolve_and_scopes():
+    parent = DependencyContainer()
+    parent.register("logger", {"name": "root"})
+    child = DependencyContainer(parent)
+    child.register("config", lambda: {"flag": True})  # lazy factory
+
+    scope = child.synthesize(required=("logger", "config"), optional=("missing",))
+    assert scope.logger["name"] == "root"
+    assert scope.config["flag"] is True
+    assert scope.missing is None
+    assert "missing" in scope
+
+    with pytest.raises(KeyError):
+        child.synthesize(required=("nope",))
+    with pytest.raises(AttributeError):
+        _ = scope.never_requested
+
+    # Factory result is cached: same instance on re-resolve.
+    assert child.resolve("config") is scope.config
+
+
+# ---------------------------------------------------------------------------
+# DDS interceptions
+
+
+def test_map_interception_stamps_props():
+    svc = LocalFluidService()
+    (rt_a, [map_a]), (rt_b, [map_b]) = make_pair(
+        svc, channels=[(SharedMap, "m")]
+    )
+    seen = []
+    rt_b.on_op = lambda msg: (
+        seen.append(msg.contents) if msg.type == MessageType.OPERATION else None
+    )
+    create_shared_map_with_interception(
+        map_a, lambda contents: {"user": "alice"}
+    )
+    map_a.set("k", 1)
+    pump(rt_a, rt_b)
+    assert map_b.get("k") == 1
+    [op] = seen
+    assert op["contents"]["props"] == {"user": "alice"}
+
+
+def test_string_interception_and_merge_unaffected():
+    svc = LocalFluidService()
+    (rt_a, [str_a]), (rt_b, [str_b]) = make_pair(
+        svc, channels=[(SharedString, "s")]
+    )
+    create_shared_string_with_interception(
+        str_a, lambda contents: {"by": "bob"} if contents.get("k") == "ins" else {}
+    )
+    str_a.insert_text(0, "hi")
+    str_a.annotate(0, 2, 3)
+    pump(rt_a, rt_b)
+    assert str_b.get_text() == "hi"
+    assert str_b.annotations() == [(0, 2, 3)]
