@@ -76,18 +76,25 @@ def main() -> None:
     jops = jax.device_put(ops)
 
     state = make_batched_state(n_docs, capacity, NO_CLIENT)
-    # Warmup / compile both kernels.
+    # Warmup / compile both kernels. NOTE: on the tunneled TPU backend
+    # ``jax.block_until_ready`` returns before execution completes, so every
+    # timing step must force a (tiny) device->host readback to be honest —
+    # without it the loop silently queues unbounded device work.
     state = jit_batched_apply_ops(state, jops)
     state = batched_compact(state)
-    jax.block_until_ready(state)
+    np.asarray(state.err)
 
-    iters = 20
+    # 3 iterations keeps total bench wall-clock inside the driver's budget
+    # while the apply path costs ~13.5s/step (XLA gather-heavy scan); raise
+    # once the Pallas VMEM-resident kernel lands. With so few samples the
+    # p99 field is effectively max(times).
+    iters = 3
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
         state = jit_batched_apply_ops(state, jops)
         state = batched_compact(state)
-        jax.block_until_ready(state)
+        np.asarray(state.err)  # forces completion of the step
         times.append(time.perf_counter() - t0)
     # Seq stamps in the replayed stream repeat, which is harmless for the
     # apply cost; compaction each round keeps tables bounded like zamboni.
