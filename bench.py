@@ -66,35 +66,45 @@ def cpu_oracle_baseline(ops_one_doc: np.ndarray) -> float:
 def main() -> None:
     import jax
 
-    from fluidframework_tpu.ops.merge_kernel import batched_compact, jit_batched_apply_ops
-    from fluidframework_tpu.ops.segment_state import SegmentState, make_batched_state
+    from fluidframework_tpu.ops.pallas_compact import compact_packed
+    from fluidframework_tpu.ops.pallas_kernel import (
+        SC_ERR,
+        _on_tpu,
+        apply_ops_packed,
+        pack_state,
+        unpack_state,
+    )
+    from fluidframework_tpu.ops.segment_state import make_batched_state
     from fluidframework_tpu.protocol.constants import NO_CLIENT
 
+    on_tpu = _on_tpu()
     rng = np.random.default_rng(0)
-    n_docs, capacity, k = 2048, 256, 64
-    ops = build_op_stream(n_docs, k, rng)
-    jops = jax.device_put(ops)
+    n_docs, capacity, k, blk = 32768, 256, 64, 32
+    if not on_tpu:  # smoke-test shapes for CPU interpret mode
+        n_docs, blk = 64, 8
+    host_ops = build_op_stream(n_docs, k, rng)
+    ops = jax.device_put(host_ops)
 
-    state = make_batched_state(n_docs, capacity, NO_CLIENT)
-    # Warmup / compile both kernels. NOTE: on the tunneled TPU backend
+    def step(tables, scalars):
+        tables, scalars = apply_ops_packed(
+            tables, scalars, ops, block_docs=blk, interpret=not on_tpu
+        )
+        return compact_packed(tables, scalars, interpret=not on_tpu)
+
+    tables, scalars = pack_state(make_batched_state(n_docs, capacity, NO_CLIENT))
+    # Warmup / compile both Pallas kernels. NOTE: on the tunneled TPU backend
     # ``jax.block_until_ready`` returns before execution completes, so every
     # timing step must force a (tiny) device->host readback to be honest —
     # without it the loop silently queues unbounded device work.
-    state = jit_batched_apply_ops(state, jops)
-    state = batched_compact(state)
-    np.asarray(state.err)
+    tables, scalars = step(tables, scalars)
+    np.asarray(scalars[:, SC_ERR])
 
-    # 3 iterations keeps total bench wall-clock inside the driver's budget
-    # while the apply path costs ~13.5s/step (XLA gather-heavy scan); raise
-    # once the Pallas VMEM-resident kernel lands. With so few samples the
-    # p99 field is effectively max(times).
-    iters = 3
+    iters = 5
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        state = jit_batched_apply_ops(state, jops)
-        state = batched_compact(state)
-        np.asarray(state.err)  # forces completion of the step
+        tables, scalars = step(tables, scalars)
+        np.asarray(scalars[:, SC_ERR])  # forces completion of the step
         times.append(time.perf_counter() - t0)
     # Seq stamps in the replayed stream repeat, which is harmless for the
     # apply cost; compaction each round keeps tables bounded like zamboni.
@@ -103,8 +113,9 @@ def main() -> None:
     throughput = total_ops / elapsed
     p99_batch_ms = float(np.percentile(np.array(times), 99) * 1e3)
 
+    state = unpack_state(tables, scalars)
     errs = int(np.sum(np.asarray(state.err) != 0))
-    baseline = cpu_oracle_baseline(ops[0])
+    baseline = cpu_oracle_baseline(host_ops[0])
 
     print(
         json.dumps(

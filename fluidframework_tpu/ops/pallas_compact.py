@@ -1,0 +1,203 @@
+"""Pallas TPU compaction kernel — the zamboni equivalent, scatter-free.
+
+The XLA :func:`merge_kernel.compact` costs ~150ms at service scale because
+its squeeze is a general scatter, which TPUs execute serially. This kernel
+reformulates compaction as a *permutation matmul on the MXU*: the squeeze
+``out[t] = lane[j]`` (``t = dest[j]``) is ``P @ lane`` with the 0/1 matrix
+``P[t, j] = keep[j] & (dest[j] == t)`` — each row of ``P`` has at most one
+1, so there is no accumulation, and int32 lanes transported as two exact
+15-bit halves (both < 2^24, exact in f32) reassemble losslessly.
+
+Semantics are identical to the XLA compact (pinned by parity tests):
+
+1. reclaim tombstones with ``removedSeq <= minSeq`` and no pending local
+   stamps (zamboni rule, ``zamboni.ts:19``), squeeze live rows down;
+2. re-merge adjacent rows that are splits of one acked, unremoved,
+   identically-annotated insert (conservative ``packParent``), via a second
+   head-squeeze whose merged lengths come from prefix-sum differences
+   (head t's run length = next head's prefix-length - its own).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from fluidframework_tpu.ops.pallas_kernel import (
+    N_LANES,
+    N_SCALARS,
+    SC_COUNT,
+    SC_MIN_SEQ,
+    _excl_cumsum,
+    _on_tpu,
+    _shift_right,
+    pack_state,
+    unpack_state,
+)
+from fluidframework_tpu.ops.segment_state import SEGMENT_LANES, SegmentState
+from fluidframework_tpu.protocol.constants import (
+    KIND_FREE,
+    KIND_TEXT,
+    RSEQ_NONE,
+    UNASSIGNED_SEQ,
+)
+
+_I32 = jnp.int32
+_F32 = jnp.float32
+
+L_KIND = SEGMENT_LANES.index("kind")
+L_ORIG = SEGMENT_LANES.index("orig")
+L_OFF = SEGMENT_LANES.index("off")
+L_LEN = SEGMENT_LANES.index("length")
+L_SEQ = SEGMENT_LANES.index("seq")
+L_CLIENT = SEGMENT_LANES.index("client")
+L_LSEQ = SEGMENT_LANES.index("lseq")
+L_RSEQ = SEGMENT_LANES.index("rseq")
+L_RLSEQ = SEGMENT_LANES.index("rlseq")
+L_ASEQ = SEGMENT_LANES.index("aseq")
+L_ALSEQ = SEGMENT_LANES.index("alseq")
+L_AVAL = SEGMENT_LANES.index("aval")
+
+_FILLS = {L_KIND: KIND_FREE, L_RSEQ: RSEQ_NONE}
+
+
+def _permute(dest, do, x, b, s):
+    """out[d, t, :] = x[d, j, :] where dest[d, j] == t and do[d, j].
+
+    ``x``: [B, S, C] int32. Batched MXU matmul; zeros in unwritten rows.
+    """
+    row_t = jax.lax.broadcasted_iota(_I32, (b, s, s), 1)
+    p = ((dest[:, None, :] == row_t) & do[:, None, :]).astype(_F32)
+    hi = (x >> 15).astype(_F32)
+    lo = (x & 0x7FFF).astype(_F32)
+    both = jnp.concatenate([hi, lo], axis=2)  # [B, S, 2C]
+    # HIGHEST precision is load-bearing: the default TPU f32 matmul runs on
+    # the MXU as bf16 passes, which rounds 15-bit halves and silently
+    # corrupts reassembled int32 lanes.
+    out = jax.lax.dot_general(
+        p,
+        both,
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=_F32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    c = x.shape[2]
+    return out[:, :, :c].astype(_I32) * 32768 + out[:, :, c:].astype(_I32)
+
+
+def _kernel(tables_ref, scalars_ref, otables_ref, oscalars_ref):
+    b, s = tables_ref.shape[1], tables_ref.shape[2]
+    col = jax.lax.broadcasted_iota(_I32, (b, s), 1)
+    lanes = [tables_ref[i] for i in range(N_LANES)]
+    min_seq = scalars_ref[:, SC_MIN_SEQ : SC_MIN_SEQ + 1]
+
+    kind, rseq = lanes[L_KIND], lanes[L_RSEQ]
+    live = kind != KIND_FREE
+    pending = (lanes[L_LSEQ] != 0) | (lanes[L_RLSEQ] != 0) | (lanes[L_ALSEQ] != 0)
+    reclaim = (
+        live
+        & ~pending
+        & (rseq != RSEQ_NONE)
+        & (rseq != UNASSIGNED_SEQ)
+        & (rseq <= min_seq)
+    )
+    keep = live & ~reclaim
+    dest = _excl_cumsum(keep.astype(_I32))
+    n = jnp.sum(keep.astype(_I32), axis=1, keepdims=True)
+
+    sq = _permute(dest, keep, jnp.stack(lanes, axis=2), b, s)
+    valid = col < n
+    sq_lanes = [
+        jnp.where(valid, sq[:, :, i], _FILLS.get(i, 0)) for i in range(N_LANES)
+    ]
+
+    # -- sibling re-merge (packParent subset) --------------------------------
+    prev = [_shift_right(x, 1) for x in sq_lanes]
+    mergeable = (
+        valid
+        & (col > 0)
+        & (sq_lanes[L_KIND] == KIND_TEXT)
+        & (prev[L_KIND] == KIND_TEXT)
+        & (sq_lanes[L_ORIG] == prev[L_ORIG])
+        & (sq_lanes[L_OFF] == prev[L_OFF] + prev[L_LEN])
+        & (sq_lanes[L_SEQ] == prev[L_SEQ])
+        & (sq_lanes[L_CLIENT] == prev[L_CLIENT])
+        & (sq_lanes[L_SEQ] != UNASSIGNED_SEQ)
+        & (sq_lanes[L_RSEQ] == RSEQ_NONE)
+        & (prev[L_RSEQ] == RSEQ_NONE)
+        & (sq_lanes[L_ASEQ] == prev[L_ASEQ])
+        & (sq_lanes[L_AVAL] == prev[L_AVAL])
+        & (sq_lanes[L_ALSEQ] == 0)
+        & (prev[L_ALSEQ] == 0)
+        & (sq_lanes[L_LSEQ] == 0)
+        & (prev[L_LSEQ] == 0)
+    )
+    head = valid & ~mergeable
+    n_heads = jnp.sum(head.astype(_I32), axis=1, keepdims=True)
+    dest_h = _excl_cumsum(head.astype(_I32))
+
+    vlen = jnp.where(valid, sq_lanes[L_LEN], 0)
+    total = jnp.sum(vlen, axis=1, keepdims=True)
+    plen = _excl_cumsum(vlen)
+
+    hq = _permute(dest_h, head, jnp.stack(sq_lanes + [plen], axis=2), b, s)
+    valid_h = col < n_heads
+    out_lanes = [
+        jnp.where(valid_h, hq[:, :, i], _FILLS.get(i, 0)) for i in range(N_LANES)
+    ]
+    # Merged length of head t = (next head's prefix length, or total) - own.
+    pl_sq = jnp.where(valid_h, hq[:, :, N_LANES], 0)
+    pl_next = jnp.concatenate([pl_sq[:, 1:], jnp.zeros((b, 1), _I32)], axis=1)
+    nxt = jnp.where(col + 1 < n_heads, pl_next, total)
+    out_lanes[L_LEN] = jnp.where(valid_h, nxt - pl_sq, 0)
+
+    for i in range(N_LANES):
+        otables_ref[i] = out_lanes[i]
+    sc_col = jax.lax.broadcasted_iota(_I32, (b, N_SCALARS), 1)
+    oscalars_ref[...] = jnp.where(sc_col == SC_COUNT, n_heads, scalars_ref[...])
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_docs", "interpret"), donate_argnums=(0, 1)
+)
+def compact_packed(tables, scalars, *, block_docs=8, interpret=False):
+    n_docs, cap = tables.shape[1], tables.shape[2]
+    # The permutation matrix is [blk, cap, cap] f32 — bound its VMEM share.
+    blk = min(block_docs, n_docs, max(1, (4 << 20) // (cap * cap * 4)))
+    while n_docs % blk != 0:
+        blk -= 1
+    out = pl.pallas_call(
+        _kernel,
+        grid=(n_docs // blk,),
+        in_specs=[
+            pl.BlockSpec((N_LANES, blk, cap), lambda i: (0, i, 0)),
+            pl.BlockSpec((blk, N_SCALARS), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((N_LANES, blk, cap), lambda i: (0, i, 0)),
+            pl.BlockSpec((blk, N_SCALARS), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(tables.shape, _I32),
+            jax.ShapeDtypeStruct(scalars.shape, _I32),
+        ],
+        input_output_aliases={0: 0, 1: 1},
+        interpret=interpret,
+    )(tables, scalars)
+    return out[0], out[1]
+
+
+def pallas_batched_compact(
+    state: SegmentState, *, block_docs: int = 8, interpret=None
+) -> SegmentState:
+    """Drop-in equivalent of ``merge_kernel.batched_compact``."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    tables, scalars = pack_state(state)
+    tables, scalars = compact_packed(
+        tables, scalars, block_docs=block_docs, interpret=interpret
+    )
+    return unpack_state(tables, scalars)

@@ -1,0 +1,406 @@
+"""Pallas TPU kernel for batched merge-op application.
+
+Why this exists: the XLA formulation in :mod:`merge_kernel` streams every
+per-segment lane through HBM once per sequenced op (a ``lax.scan`` step) and
+``vmap`` turns its per-op ``lax.switch`` into execute-all-7-branches — on a
+v5e chip that measures ~10k ops/s, *slower than the pure-Python oracle*. The
+hot loop is memory-latency-bound, not compute-bound: the fix is to keep each
+document's segment table resident in VMEM for the whole op batch and apply
+ops as branch-free vector arithmetic. That is exactly what this kernel does:
+
+- Grid over blocks of documents; each grid step DMAs its block's lanes
+  (13 int32 lanes x [block, capacity]) into VMEM once, applies all K ops with
+  a ``fori_loop``, and writes the block back once. HBM traffic per op batch
+  is O(state), not O(state * K).
+- One *unified* op pipeline instead of 7 switch branches: every op type is
+  expressed as (optional) boundary splits + (optional) new-row placement +
+  masked lane updates, gated by per-document type masks. Insert, remove and
+  annotate share the same perspective/prefix-sum/first-hit machinery
+  (reference ``mergeTree.ts`` ``insertingWalk:1740``/``breakTie:1719``/
+  ``markRangeRemoved:1955``/``annotateRange:1895``; SURVEY.md Appendix A).
+- Row shifts (B-tree node inserts in the reference) are static shift-by-one
+  selects, prefix sums are Hillis-Steele log-step shifts — no gathers or
+  scatters anywhere, which TPUs execute serially.
+
+Semantics are bit-identical to :func:`merge_kernel.batched_apply_ops` for
+well-formed op streams (``pos2 > pos1`` on range ops, as produced by
+``ops.encode``); the parity fuzz in ``tests/test_pallas_kernel.py`` pins
+kernel-vs-kernel and kernel-vs-oracle equivalence, including capacity
+overflow and out-of-range behavior.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from fluidframework_tpu.ops.segment_state import SEGMENT_LANES, SegmentState
+from fluidframework_tpu.protocol.constants import (
+    ERR_CAPACITY,
+    ERR_CLIENT,
+    ERR_RANGE,
+    F_ARG,
+    F_CLIENT,
+    F_LEN,
+    F_LSEQ,
+    F_MSN,
+    F_POS1,
+    F_POS2,
+    F_REF,
+    F_SEQ,
+    F_TYPE,
+    KIND_FREE,
+    KIND_TEXT,
+    MAX_WRITERS,
+    NORM_EXISTING_LOCAL,
+    NORM_NEW_LOCAL,
+    OP_ACK_ANNOTATE,
+    OP_ACK_INSERT,
+    OP_ACK_REMOVE,
+    OP_ANNOTATE,
+    OP_INSERT,
+    OP_REMOVE,
+    OP_WIDTH,
+    RSEQ_NONE,
+    UNASSIGNED_SEQ,
+)
+
+_I32 = jnp.int32
+N_LANES = len(SEGMENT_LANES)
+# Scalar pack layout (lane dim of the [D, N_SCALARS] array).
+SC_COUNT, SC_MIN_SEQ, SC_CUR_SEQ, SC_SELF, SC_ERR = range(5)
+N_SCALARS = 8  # padded for sublane friendliness
+
+
+def _shift_right(x: jnp.ndarray, d: int) -> jnp.ndarray:
+    """Shift columns right by static d along the last axis, zero-fill."""
+    b, s = x.shape
+    return jnp.concatenate([jnp.zeros((b, d), x.dtype), x[:, : s - d]], axis=1)
+
+
+def _excl_cumsum(x: jnp.ndarray) -> jnp.ndarray:
+    """Exclusive prefix sum along lanes (Hillis-Steele log-step shifts)."""
+    s = x.shape[1]
+    y = x
+    d = 1
+    while d < s:
+        y = y + _shift_right(y, d)
+        d *= 2
+    return y - x
+
+
+def _kernel(ops_ref, tables_ref, scalars_ref, otables_ref, oscalars_ref):
+    k_total = ops_ref.shape[0]
+    b, s = tables_ref.shape[1], tables_ref.shape[2]
+    col = jax.lax.broadcasted_iota(_I32, (b, s), 1)
+
+    def first_true(mask):
+        """(has, idx) of the first true column per document row."""
+        idx = jnp.min(jnp.where(mask, col, s), axis=1, keepdims=True)
+        return idx < s, idx
+
+    def value_at(val, idx):
+        """val[:, idx] per document row, as [b, 1] (one-hot reduction)."""
+        return jnp.sum(jnp.where(col == idx, val, 0), axis=1, keepdims=True)
+
+    def shift1(lanes, do, q, strict):
+        """Rows at col > q (or >= q when not strict) take their left
+        neighbour's value — the vectorized B-tree row shift."""
+        edge = jnp.where(strict, q, q - 1)
+        return [jnp.where(do & (col > edge), _shift_right(x, 1), x) for x in lanes]
+
+    def step(k, carry):
+        lanes, count, min_seq, cur_seq, self_client, err = carry
+        (kind, orig, off, length, seq, client, lseq, rseq, rlseq, rbits,
+         aseq, alseq, aval) = lanes
+
+        op = jnp.reshape(ops_ref[pl.ds(k, 1), :, :], (b, OP_WIDTH))
+
+        def f(i):
+            return op[:, i : i + 1]
+
+        ty = f(F_TYPE)
+        pos1, pos2 = f(F_POS1), f(F_POS2)
+        seqn, refn, clientn = f(F_SEQ), f(F_REF), f(F_CLIENT)
+        lseqn, arg, ilen, msn = f(F_LSEQ), f(F_ARG), f(F_LEN), f(F_MSN)
+
+        is_ins = ty == OP_INSERT
+        is_rem = ty == OP_REMOVE
+        is_ann = ty == OP_ANNOTATE
+        is_range = is_rem | is_ann
+        local_op = seqn == UNASSIGNED_SEQ
+        is_local = clientn == self_client
+        cshift = jnp.clip(clientn, 0, 31)
+
+        # -- perspective (merge_kernel.perspective, mergeTree.ts:916-1004) --
+        def perspective(kind_, seq_, client_, length_, rseq_, rbits_):
+            live = kind_ != KIND_FREE
+            removed = rseq_ != RSEQ_NONE
+            r_acked = removed & (rseq_ != UNASSIGNED_SEQ)
+            skip = r_acked & (rseq_ <= min_seq)
+            rseq_eff = jnp.where(rseq_ == UNASSIGNED_SEQ, RSEQ_NONE, rseq_)
+            removed_by_client = ((rbits_ >> cshift) & 1) == 1
+            hidden = removed & ((rseq_eff <= refn) | removed_by_client)
+            seq_eff = jnp.where(seq_ == UNASSIGNED_SEQ, NORM_EXISTING_LOCAL, seq_)
+            ins_vis = (client_ == clientn) | (seq_eff <= refn)
+            vis_remote = jnp.where(~hidden & ins_vis, length_, 0)
+            vis_local = jnp.where(removed, 0, length_)
+            vis = jnp.where(is_local, vis_local, vis_remote)
+            part = live & ~skip
+            return part, jnp.where(part, vis, 0)
+
+        part, vis = perspective(kind, seq, client, length, rseq, rbits)
+        prefix = _excl_cumsum(vis)
+        total = jnp.sum(vis, axis=1, keepdims=True)
+        rem1 = pos1 - prefix
+        rem2 = pos2 - prefix
+
+        # Strictly-inside hits = boundary splits needed (ensureIntervalBoundary).
+        strict1 = part & (vis > 0) & (rem1 > 0) & (rem1 < vis)
+        strict2 = part & (vis > 0) & (rem2 > 0) & (rem2 < vis)
+        has1, idx1 = first_true(strict1)
+        has2, idx2 = first_true(strict2)
+        split1 = value_at(rem1, idx1)
+        split2 = value_at(rem2, idx2)
+
+        # Insert placement with tie-break (insertingWalk + breakTie).
+        op_norm = jnp.where(local_op, NORM_NEW_LOCAL, seqn)
+        seg_norm = jnp.where(seq == UNASSIGNED_SEQ, NORM_EXISTING_LOCAL, seq)
+        place = part & (
+            ((vis > 0) & (rem1 >= 0) & (rem1 < vis))
+            | ((vis == 0) & (rem1 == 0) & (op_norm > seg_norm))
+        )
+        hasp, idxp = first_true(place)
+        idxp = jnp.where(hasp, idxp, count)
+
+        # -- capacity / do flags (sequential checks, as the XLA kernel) ----
+        sh = jnp.where(has1, 2, 1)
+        cap_err_i = is_ins & (count + sh > s)
+        do_ins = is_ins & ~cap_err_i
+        do_a_rng = is_range & has1 & (count + 1 <= s)
+        cap_a = is_range & has1 & (count + 1 > s)
+        count_a = count + jnp.where(do_a_rng, 1, 0)
+        do_b_rng = is_range & has2 & (count_a + 1 <= s)
+        cap_b = is_range & has2 & (count_a + 1 > s)
+
+        err = (
+            err
+            | jnp.where(cap_err_i | cap_a | cap_b, ERR_CAPACITY, 0)
+            | jnp.where(is_ins & ~hasp & (pos1 > total), ERR_RANGE, 0)
+            | jnp.where(is_range & (pos2 > total), ERR_RANGE, 0)
+            | jnp.where(clientn >= MAX_WRITERS, ERR_CLIENT, 0)
+        )
+
+        lanes = [kind, orig, off, length, seq, client, lseq, rseq, rlseq,
+                 rbits, aseq, alseq, aval]
+        I_OFF, I_LEN = 2, 3
+
+        # -- split A at pos1 (insert mid-segment or range start) -----------
+        do_a = do_a_rng | (do_ins & has1)
+        lanes = shift1(lanes, do_a, idx1, strict=True)
+        m_q = do_a & (col == idx1)
+        m_q1 = do_a & (col == idx1 + 1)
+        lanes[I_LEN] = jnp.where(m_q, split1, lanes[I_LEN])
+        lanes[I_OFF] = jnp.where(m_q1, lanes[I_OFF] + split1, lanes[I_OFF])
+        lanes[I_LEN] = jnp.where(m_q1, lanes[I_LEN] - split1, lanes[I_LEN])
+
+        # -- split B at pos2 (range ops; index/length in post-A space) -----
+        same_row = do_a_rng & (idx1 == idx2)
+        q_b = idx2 + jnp.where(do_a_rng, 1, 0)
+        l_b = jnp.where(same_row, split2 - split1, split2)
+        lanes = shift1(lanes, do_b_rng, q_b, strict=True)
+        m_q = do_b_rng & (col == q_b)
+        m_q1 = do_b_rng & (col == q_b + 1)
+        lanes[I_LEN] = jnp.where(m_q, l_b, lanes[I_LEN])
+        lanes[I_OFF] = jnp.where(m_q1, lanes[I_OFF] + l_b, lanes[I_OFF])
+        lanes[I_LEN] = jnp.where(m_q1, lanes[I_LEN] - l_b, lanes[I_LEN])
+
+        # -- insert the new row (between split halves, or at placement) ----
+        q_i = jnp.where(has1, idx1 + 1, idxp)
+        lanes = shift1(lanes, do_ins, q_i, strict=False)
+        m_new = do_ins & (col == q_i)
+        new_row = [
+            jnp.full((b, s), KIND_TEXT, _I32),  # kind
+            jnp.broadcast_to(arg, (b, s)),  # orig
+            jnp.zeros((b, s), _I32),  # off
+            jnp.broadcast_to(ilen, (b, s)),  # length
+            jnp.broadcast_to(seqn, (b, s)),  # seq
+            jnp.broadcast_to(clientn, (b, s)),  # client
+            jnp.broadcast_to(jnp.where(local_op, lseqn, 0), (b, s)),  # lseq
+            jnp.full((b, s), RSEQ_NONE, _I32),  # rseq
+            jnp.zeros((b, s), _I32),  # rlseq
+            jnp.zeros((b, s), _I32),  # rbits
+            jnp.zeros((b, s), _I32),  # aseq
+            jnp.zeros((b, s), _I32),  # alseq
+            jnp.zeros((b, s), _I32),  # aval
+        ]
+        lanes = [jnp.where(m_new, nv, x) for nv, x in zip(new_row, lanes)]
+
+        count = jnp.where(
+            is_range,
+            count_a + jnp.where(do_b_rng, 1, 0),
+            jnp.where(do_ins, count + sh, count),
+        )
+
+        (kind, orig, off, length, seq, client, lseq, rseq, rlseq, rbits,
+         aseq, alseq, aval) = lanes
+
+        # -- covered rows (post-split perspective; _covered/nodeMap) -------
+        part2, vis2 = perspective(kind, seq, client, length, rseq, rbits)
+        prefix2 = _excl_cumsum(vis2)
+        cov = (
+            part2
+            & (vis2 > 0)
+            & (prefix2 >= pos1)
+            & (prefix2 + vis2 <= pos2)
+        )
+
+        # -- remove marks (markRangeRemoved:1975-1990) ---------------------
+        m_rem = cov & is_rem
+        not_removed = rseq == RSEQ_NONE
+        was_local = rseq == UNASSIGNED_SEQ
+        bit = (jnp.int32(1) << cshift).astype(_I32)
+        rseq = jnp.where(
+            m_rem & (not_removed | was_local), jnp.broadcast_to(seqn, (b, s)), rseq
+        )
+        rlseq = jnp.where(
+            m_rem & not_removed & local_op, jnp.broadcast_to(lseqn, (b, s)), rlseq
+        )
+        rbits = jnp.where(m_rem, rbits | bit, rbits)
+
+        # -- annotate marks (annotateRange; single-lane LWW) ---------------
+        pending = alseq != 0
+        m_ann = cov & is_ann & (local_op | ~pending)
+        aval = jnp.where(m_ann, jnp.broadcast_to(arg, (b, s)), aval)
+        aseq = jnp.where(m_ann, jnp.broadcast_to(seqn, (b, s)), aseq)
+        alseq = jnp.where(
+            m_ann, jnp.broadcast_to(jnp.where(local_op, lseqn, 0), (b, s)), alseq
+        )
+
+        # -- acks of own ops (ackPendingSegment, mergeTree.ts:1283) --------
+        live = kind != KIND_FREE
+        m_aci = (ty == OP_ACK_INSERT) & live & (seq == UNASSIGNED_SEQ) & (
+            lseq == lseqn
+        )
+        seq = jnp.where(m_aci, jnp.broadcast_to(seqn, (b, s)), seq)
+        lseq = jnp.where(m_aci, 0, lseq)
+
+        m_acr = (ty == OP_ACK_REMOVE) & live & (rlseq == lseqn)
+        rseq = jnp.where(
+            m_acr & (rseq == UNASSIGNED_SEQ), jnp.broadcast_to(seqn, (b, s)), rseq
+        )
+        rlseq = jnp.where(m_acr, 0, rlseq)
+
+        m_aca = (ty == OP_ACK_ANNOTATE) & live & (alseq == lseqn)
+        aseq = jnp.where(m_aca, jnp.broadcast_to(seqn, (b, s)), aseq)
+        alseq = jnp.where(m_aca, 0, alseq)
+
+        # -- bookkeeping (collab window floor / current seq) ---------------
+        cur_seq = jnp.maximum(cur_seq, seqn)
+        min_seq = jnp.maximum(min_seq, msn)
+
+        lanes = [kind, orig, off, length, seq, client, lseq, rseq, rlseq,
+                 rbits, aseq, alseq, aval]
+        return lanes, count, min_seq, cur_seq, self_client, err
+
+    lanes0 = [tables_ref[i] for i in range(N_LANES)]
+    count0 = scalars_ref[:, SC_COUNT : SC_COUNT + 1]
+    min_seq0 = scalars_ref[:, SC_MIN_SEQ : SC_MIN_SEQ + 1]
+    cur_seq0 = scalars_ref[:, SC_CUR_SEQ : SC_CUR_SEQ + 1]
+    self0 = scalars_ref[:, SC_SELF : SC_SELF + 1]
+    err0 = scalars_ref[:, SC_ERR : SC_ERR + 1]
+
+    lanes, count, min_seq, cur_seq, self_client, err = jax.lax.fori_loop(
+        0, k_total, step, (lanes0, count0, min_seq0, cur_seq0, self0, err0)
+    )
+
+    for i in range(N_LANES):
+        otables_ref[i] = lanes[i]
+    zpad = jnp.zeros((count.shape[0], N_SCALARS - 5), _I32)
+    oscalars_ref[:, :] = jnp.concatenate(
+        [count, min_seq, cur_seq, self_client, err, zpad], axis=1
+    )
+
+
+def pack_state(state: SegmentState):
+    """SegmentState -> (tables [N_LANES, D, S], scalars [D, N_SCALARS])."""
+    tables = jnp.stack([getattr(state, k) for k in SEGMENT_LANES], axis=0)
+    scalars = jnp.stack(
+        [state.count, state.min_seq, state.cur_seq, state.self_client, state.err]
+        + [jnp.zeros_like(state.count)] * (N_SCALARS - 5),
+        axis=-1,
+    ).astype(_I32)
+    return tables, scalars
+
+
+def unpack_state(tables, scalars) -> SegmentState:
+    return SegmentState(
+        **{k: tables[i] for i, k in enumerate(SEGMENT_LANES)},
+        count=scalars[..., SC_COUNT],
+        min_seq=scalars[..., SC_MIN_SEQ],
+        cur_seq=scalars[..., SC_CUR_SEQ],
+        self_client=scalars[..., SC_SELF],
+        err=scalars[..., SC_ERR],
+    )
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() not in ("cpu", "gpu")
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_docs", "interpret"),
+    donate_argnums=(0, 1),
+)
+def apply_ops_packed(tables, scalars, ops, *, block_docs=64, interpret=False):
+    """Apply ops [D, K, OP_WIDTH] to a packed state; D % block_docs == 0."""
+    n_docs = tables.shape[1]
+    cap = tables.shape[2]
+    k = ops.shape[1]
+    blk = min(block_docs, n_docs)
+    assert n_docs % blk == 0, "pad n_docs to a multiple of block_docs"
+    ops_t = jnp.transpose(ops.astype(_I32), (1, 0, 2))  # [K, D, W]
+    grid = (n_docs // blk,)
+    out = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((k, blk, OP_WIDTH), lambda i: (0, i, 0)),
+            pl.BlockSpec((N_LANES, blk, cap), lambda i: (0, i, 0)),
+            pl.BlockSpec((blk, N_SCALARS), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((N_LANES, blk, cap), lambda i: (0, i, 0)),
+            pl.BlockSpec((blk, N_SCALARS), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(tables.shape, _I32),
+            jax.ShapeDtypeStruct(scalars.shape, _I32),
+        ],
+        input_output_aliases={1: 0, 2: 1},
+        interpret=interpret,
+    )(ops_t, tables, scalars)
+    return out[0], out[1]
+
+
+def pallas_batched_apply_ops(
+    state: SegmentState, ops, *, block_docs: int = 64, interpret=None
+) -> SegmentState:
+    """Drop-in equivalent of ``merge_kernel.batched_apply_ops`` running the
+    VMEM-resident Pallas kernel. ``interpret=None`` auto-selects interpreter
+    mode off-TPU (CPU tests)."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    n_docs = state.kind.shape[0]
+    blk = block_docs
+    while n_docs % blk != 0:
+        blk //= 2
+    tables, scalars = pack_state(state)
+    tables, scalars = apply_ops_packed(
+        tables, scalars, ops, block_docs=blk, interpret=interpret
+    )
+    return unpack_state(tables, scalars)

@@ -1,0 +1,233 @@
+"""Parity: Pallas VMEM kernel vs the XLA merge kernel (and the oracle).
+
+The Pallas kernel must be bit-identical to ``merge_kernel.batched_apply_ops``
+for well-formed op streams — same lanes, same scalars, same error flags —
+since replicas may mix executors (CPU client vs TPU service) and still have
+to converge. Runs in interpreter mode off-TPU.
+"""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.ops import encode as E
+from fluidframework_tpu.ops.merge_kernel import batched_apply_ops
+from fluidframework_tpu.ops.pallas_kernel import pallas_batched_apply_ops
+from fluidframework_tpu.ops.segment_state import (
+    SEGMENT_LANES,
+    make_batched_state,
+    materialize,
+    SegmentState,
+)
+from fluidframework_tpu.protocol.constants import (
+    ERR_CAPACITY,
+    ERR_RANGE,
+    NO_CLIENT,
+    OP_WIDTH,
+    UNASSIGNED_SEQ,
+)
+from fluidframework_tpu.testing.oracle import OracleDoc
+
+
+def assert_states_equal(a: SegmentState, b: SegmentState):
+    for k in SEGMENT_LANES + ("count", "min_seq", "cur_seq", "err"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, k)), np.asarray(getattr(b, k)), err_msg=k
+        )
+
+
+def random_acked_stream(rng, n_ops, payloads, track: OracleDoc):
+    """Valid fully-acked sequenced ops, evolving alongside an oracle."""
+    ops = []
+    next_orig = len(payloads) + 1
+    for seq in range(1, n_ops + 1):
+        length = len(track.text(payloads))
+        kind = int(rng.integers(0, 3)) if length > 0 else 0
+        client = int(rng.integers(0, 6))
+        if kind == 0:
+            n = int(rng.integers(1, 6))
+            payloads[next_orig] = "x" * n
+            op = E.insert(
+                int(rng.integers(0, length + 1)), next_orig, n,
+                seq=seq, ref=int(rng.integers(0, seq)), client=client,
+            )
+            next_orig += 1
+        elif kind == 1:
+            a = int(rng.integers(0, length))
+            b = int(rng.integers(a + 1, length + 1))
+            op = E.remove(a, b, seq=seq, ref=seq - 1, client=client)
+        else:
+            a = int(rng.integers(0, length))
+            b = int(rng.integers(a + 1, length + 1))
+            op = E.annotate(
+                a, b, int(rng.integers(1, 100)), seq=seq, ref=seq - 1,
+                client=client,
+            )
+        ops.append(op)
+        track.apply(op)
+    return ops
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_parity_random_acked_streams(seed):
+    rng = np.random.default_rng(seed)
+    payloads = {}
+    ops = np.stack(random_acked_stream(rng, 48, payloads, OracleDoc(NO_CLIENT)))
+    batch = np.broadcast_to(ops, (4,) + ops.shape).astype(np.int32).copy()
+    s_x = batched_apply_ops(make_batched_state(4, 128, NO_CLIENT), batch)
+    s_p = pallas_batched_apply_ops(
+        make_batched_state(4, 128, NO_CLIENT), batch, block_docs=2
+    )
+    assert_states_equal(s_x, s_p)
+
+
+def test_parity_distinct_docs_in_one_batch():
+    """Each doc in the batch runs a different stream; grid blocks of 2."""
+    n_docs, n_ops = 8, 32
+    streams, payloads = [], {}
+    for d in range(n_docs):
+        rng = np.random.default_rng(100 + d)
+        streams.append(
+            np.stack(random_acked_stream(rng, n_ops, payloads, OracleDoc(NO_CLIENT)))
+        )
+    batch = np.stack(streams).astype(np.int32)
+    s_x = batched_apply_ops(make_batched_state(n_docs, 128, NO_CLIENT), batch)
+    s_p = pallas_batched_apply_ops(
+        make_batched_state(n_docs, 128, NO_CLIENT), batch, block_docs=2
+    )
+    assert_states_equal(s_x, s_p)
+    # And against the oracle for one doc.
+    doc = OracleDoc(NO_CLIENT)
+    for row in streams[3]:
+        doc.apply(row)
+    one = SegmentState(*[np.asarray(x)[3] for x in s_p])
+    assert materialize(one, payloads) == doc.text(payloads)
+
+
+def test_parity_local_ops_and_acks():
+    """Client-side flow: pending local ops at UNASSIGNED_SEQ, then acks."""
+    self_client = 2
+    rows = [
+        E.insert(0, 1, 5, seq=1, ref=0, client=0),  # remote baseline
+        E.insert(2, 2, 3, client=self_client, lseq=1),  # local pending
+        E.remove(1, 4, client=self_client, lseq=2),  # local pending remove
+        E.annotate(0, 2, 7, client=self_client, lseq=3),  # local pending
+        E.insert(1, 3, 2, seq=2, ref=1, client=4),  # concurrent remote
+        E.ack("insert", lseq=1, seq=3),
+        E.ack("remove", lseq=2, seq=4),
+        E.ack("annotate", lseq=3, seq=5),
+    ]
+    batch = np.broadcast_to(np.stack(rows), (2, len(rows), OP_WIDTH)).astype(
+        np.int32
+    ).copy()
+    s_x = batched_apply_ops(make_batched_state(2, 128, self_client), batch)
+    s_p = pallas_batched_apply_ops(
+        make_batched_state(2, 128, self_client), batch, block_docs=2
+    )
+    assert_states_equal(s_x, s_p)
+    assert int(np.asarray(s_p.err)[0]) == 0
+
+
+def test_parity_capacity_overflow():
+    rows = [
+        E.insert(0, i + 1, 1, seq=i + 1, ref=i, client=0) for i in range(12)
+    ]
+    batch = np.broadcast_to(np.stack(rows), (2, len(rows), OP_WIDTH)).astype(
+        np.int32
+    ).copy()
+    # Capacity must be a power-of-two-ish small table; 8 rows fit, rest drop.
+    s_x = batched_apply_ops(make_batched_state(2, 8, NO_CLIENT), batch)
+    s_p = pallas_batched_apply_ops(
+        make_batched_state(2, 8, NO_CLIENT), batch, block_docs=2
+    )
+    assert_states_equal(s_x, s_p)
+    assert int(np.asarray(s_p.err)[0]) & ERR_CAPACITY
+
+
+def test_parity_out_of_range():
+    rows = [
+        E.insert(0, 1, 4, seq=1, ref=0, client=0),
+        E.insert(99, 2, 2, seq=2, ref=1, client=1),  # beyond end: append+flag
+        E.remove(2, 50, seq=3, ref=2, client=0),  # end beyond visible length
+    ]
+    batch = np.broadcast_to(np.stack(rows), (2, len(rows), OP_WIDTH)).astype(
+        np.int32
+    ).copy()
+    s_x = batched_apply_ops(make_batched_state(2, 64, NO_CLIENT), batch)
+    s_p = pallas_batched_apply_ops(
+        make_batched_state(2, 64, NO_CLIENT), batch, block_docs=2
+    )
+    assert_states_equal(s_x, s_p)
+    assert int(np.asarray(s_p.err)[0]) & ERR_RANGE
+
+
+def test_parity_collab_window_and_msn():
+    """MSN advance makes acked tombstones invisible to later perspectives."""
+    rows = [
+        E.insert(0, 1, 6, seq=1, ref=0, client=0),
+        E.remove(1, 3, seq=2, ref=1, client=1),
+        E.noop(seq=3, msn=2),
+        # Perspective from ref below the remove: tombstone now zamboni-bound.
+        E.insert(1, 2, 2, seq=4, ref=1, client=2, msn=3),
+        E.annotate(0, 4, 9, seq=5, ref=4, client=0, msn=4),
+    ]
+    batch = np.broadcast_to(np.stack(rows), (4, len(rows), OP_WIDTH)).astype(
+        np.int32
+    ).copy()
+    s_x = batched_apply_ops(make_batched_state(4, 64, NO_CLIENT), batch)
+    s_p = pallas_batched_apply_ops(
+        make_batched_state(4, 64, NO_CLIENT), batch, block_docs=4
+    )
+    assert_states_equal(s_x, s_p)
+
+
+def _copy_state(s: SegmentState) -> SegmentState:
+    import jax.numpy as jnp
+
+    return SegmentState(*[jnp.asarray(np.asarray(x)) for x in s])
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_parity_compact(seed):
+    """Pallas MXU-permutation compact == XLA scatter compact, after a random
+    stream with removes and an MSN advance (so reclaim + merge both fire)."""
+    from fluidframework_tpu.ops.merge_kernel import batched_compact
+    from fluidframework_tpu.ops.pallas_compact import pallas_batched_compact
+
+    rng = np.random.default_rng(200 + seed)
+    payloads = {}
+    ops = random_acked_stream(rng, 40, payloads, OracleDoc(NO_CLIENT))
+    n = len(ops)
+    # Advance the collab window so acked tombstones become reclaimable.
+    ops.append(E.noop(seq=n + 1, msn=n))
+    batch = np.broadcast_to(np.stack(ops), (4, n + 1, OP_WIDTH)).astype(
+        np.int32
+    ).copy()
+    st = pallas_batched_apply_ops(
+        make_batched_state(4, 128, NO_CLIENT), batch, block_docs=4
+    )
+    got = pallas_batched_compact(_copy_state(st), block_docs=4)
+    want = batched_compact(_copy_state(st))
+    assert_states_equal(want, got)
+    assert int(np.asarray(got.count)[0]) < int(np.asarray(st.count)[0])
+
+
+def test_parity_compact_preserves_pending():
+    """Rows with pending local stamps must survive compaction."""
+    from fluidframework_tpu.ops.merge_kernel import batched_compact
+    from fluidframework_tpu.ops.pallas_compact import pallas_batched_compact
+
+    self_client = 1
+    rows = [
+        E.insert(0, 1, 4, seq=1, ref=0, client=0),
+        E.insert(2, 2, 3, client=self_client, lseq=1),  # pending local
+        E.remove(0, 1, seq=2, ref=1, client=0, msn=2),  # reclaimable
+    ]
+    batch = np.broadcast_to(np.stack(rows), (2, len(rows), OP_WIDTH)).astype(
+        np.int32
+    ).copy()
+    st = pallas_batched_apply_ops(
+        make_batched_state(2, 128, self_client), batch, block_docs=2
+    )
+    got = pallas_batched_compact(_copy_state(st), block_docs=2)
+    want = batched_compact(_copy_state(st))
+    assert_states_equal(want, got)
