@@ -1,0 +1,302 @@
+"""Network driver — the routerlicious-driver equivalent.
+
+Reference: ``packages/drivers/routerlicious-driver`` — REST delta fetch
+(``deltaStorageService.ts:24``), REST git storage via historian
+(``documentStorageService.ts:24``), socket.io live delta stream
+(``documentDeltaConnection.ts:19``), HMAC-token auth (``restWrapper.ts``).
+
+The TPU build's client stack is synchronous, so this driver runs a blocking
+socket with a background reader thread per connection; the returned
+``NetworkConnection`` duck-types ``LocalConnection`` (inbox / signals /
+nacks / ``take_inbox`` / ``submit``), which means ``ContainerRuntime`` runs
+unchanged over a real network. URL scheme::
+
+    fluid-net://host:port/tenant/doc-id
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from typing import Callable, List, Optional
+from urllib.request import Request, urlopen
+
+from fluidframework_tpu.protocol.types import (
+    DocumentMessage,
+    NackMessage,
+    SequencedDocumentMessage,
+    SignalMessage,
+)
+from fluidframework_tpu.service import wsproto
+from fluidframework_tpu.service.codec import from_jsonable, to_jsonable
+from fluidframework_tpu.service.network_server import TenantManager
+from fluidframework_tpu.service.summary_store import SummaryStore
+
+URL_SCHEME = "fluid-net://"
+
+
+def parse_url(url: str):
+    assert url.startswith(URL_SCHEME), f"unsupported url {url!r}"
+    hostport, _, tail = url[len(URL_SCHEME):].partition("/")
+    host, _, port = hostport.partition(":")
+    tenant, _, doc = tail.partition("/")
+    doc = doc.split("/", 1)[0]
+    return host, int(port), tenant, doc
+
+
+class RestBlobBackend:
+    """SummaryStore backend over the server's /blobs routes (historian)."""
+
+    def __init__(self, base: str, auth: str = ""):
+        self.base = base
+        self.auth = auth
+
+    def put_blob(self, data: bytes) -> str:
+        req = Request(f"{self.base}/blobs?{self.auth}", data=data, method="POST")
+        with urlopen(req, timeout=10) as r:
+            return json.loads(r.read())["handle"]
+
+    def get_blob(self, handle: str) -> bytes:
+        with urlopen(f"{self.base}/blobs/{handle}?{self.auth}", timeout=10) as r:
+            return r.read()
+
+    def has(self, handle: str) -> bool:
+        try:
+            req = Request(
+                f"{self.base}/blobs/{handle}?{self.auth}", method="HEAD"
+            )
+            with urlopen(req, timeout=10):
+                return True
+        except Exception:
+            return False
+
+
+class NetworkConnection:
+    """Live delta stream over a websocket (DocumentDeltaConnection)."""
+
+    def __init__(self, host: str, port: int, doc_id: str, tenant: str,
+                 token: str, mode: str, from_seq: int):
+        self.doc_id = doc_id
+        self.inbox: List[SequencedDocumentMessage] = []
+        self.signals: List[SignalMessage] = []
+        self.nacks: List[NackMessage] = []
+        self.on_nack: Optional[Callable[[NackMessage], None]] = None
+        self.initial_summary: Optional[tuple] = None
+        self.client_id: int = -1
+        self.closed = False
+        self._lock = threading.Lock()
+        self._connected = threading.Event()
+        self._error: Optional[str] = None
+
+        self._sock = socket.create_connection((host, port), timeout=10)
+        try:
+            req, expect = wsproto.client_handshake(f"{host}:{port}", "/socket")
+            self._sock.sendall(req)
+            buf = b""
+            while True:
+                head = wsproto.read_http_head(buf)
+                if head is not None:
+                    break
+                chunk = self._sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError("server closed during handshake")
+                buf += chunk
+            status, headers, rest = head
+            if b"101" not in status:
+                raise ConnectionError(f"websocket upgrade failed: {status!r}")
+            if headers.get("sec-websocket-accept") != expect:
+                raise ConnectionError("bad websocket accept key")
+            self._decoder = wsproto.FrameDecoder()
+            self._pending = self._decoder.feed(rest)
+            self._send_json(
+                {
+                    "type": "connect_document",
+                    "doc": doc_id,
+                    "tenant": tenant,
+                    "token": token,
+                    "mode": mode,
+                    "from_seq": from_seq,
+                }
+            )
+            self._reader = threading.Thread(target=self._read_loop, daemon=True)
+            self._reader.start()
+            if not self._connected.wait(10):
+                raise ConnectionError("connect_document timed out")
+            if self._error is not None:
+                raise ConnectionError(self._error)
+            if self.client_id < 0:
+                # Socket dropped before connect_document_success arrived.
+                raise ConnectionError("connection closed before join completed")
+        except BaseException:
+            self.closed = True
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            raise
+
+    # -- wire ---------------------------------------------------------------
+
+    def _send_json(self, obj: dict) -> None:
+        frame = wsproto.encode_frame(
+            wsproto.OP_TEXT, json.dumps(obj).encode(), mask=True
+        )
+        self._sock.sendall(frame)
+
+    def _read_loop(self) -> None:
+        frames = self._pending
+        try:
+            while not self.closed:
+                for opcode, payload in frames:
+                    if opcode == wsproto.OP_CLOSE:
+                        return
+                    if opcode == wsproto.OP_PING:
+                        self._sock.sendall(
+                            wsproto.encode_frame(
+                                wsproto.OP_PONG, payload, mask=True
+                            )
+                        )
+                        continue
+                    if opcode == wsproto.OP_TEXT:
+                        self._on_message(json.loads(payload.decode()))
+                data = self._sock.recv(65536)
+                if not data:
+                    return
+                frames = self._decoder.feed(data)
+        except OSError:
+            pass
+        finally:
+            self.closed = True
+            self._connected.set()
+
+    def _on_message(self, msg: dict) -> None:
+        t = msg.get("type")
+        if t == "connect_document_success":
+            self.client_id = msg["client_id"]
+            if msg.get("initial_summary"):
+                self.initial_summary = tuple(msg["initial_summary"])
+            self._connected.set()
+        elif t == "connect_document_error":
+            self._error = msg.get("error", "connect failed")
+            self._connected.set()
+        elif t == "op":
+            with self._lock:
+                self.inbox.append(from_jsonable(msg["msg"]))
+        elif t == "signal":
+            self.signals.append(
+                SignalMessage(
+                    client_id=msg["client_id"],
+                    client_connection_number=msg["num"],
+                    content=msg.get("content"),
+                )
+            )
+        elif t == "nack":
+            nk = from_jsonable(msg["nack"])
+            self.nacks.append(nk)
+            if self.on_nack:
+                self.on_nack(nk)
+
+    # -- LocalConnection surface -------------------------------------------
+
+    def submit(self, msg: DocumentMessage) -> None:
+        self._send_json({"type": "submitOp", "op": to_jsonable(msg)})
+
+    def submit_signal(self, content) -> None:
+        self._send_json({"type": "submitSignal", "content": content})
+
+    def take_inbox(self, n: Optional[int] = None) -> List[SequencedDocumentMessage]:
+        with self._lock:
+            n = len(self.inbox) if n is None else min(n, len(self.inbox))
+            out, self.inbox[:] = self.inbox[:n], self.inbox[n:]
+            return out
+
+    def wait_for(self, pred, timeout: float = 10.0) -> bool:
+        """Poll until ``pred(self)`` (arrival is asynchronous over the wire —
+        the in-proc services deliver synchronously, sockets cannot). The
+        predicate runs without the inbox lock, so it may call take_inbox."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred(self):
+                return True
+            time.sleep(0.002)
+        return False
+
+    def disconnect(self) -> None:
+        if not self.closed:
+            try:
+                self._send_json({"type": "disconnect"})
+                self._sock.sendall(
+                    wsproto.encode_frame(wsproto.OP_CLOSE, b"", mask=True)
+                )
+            except OSError:
+                pass
+            self.closed = True
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+
+class NetworkFluidService:
+    """Client-side service facade bound to one server; duck-types
+    ``LocalFluidService`` for ``ContainerRuntime`` (connect / get_deltas /
+    store)."""
+
+    def __init__(self, host: str, port: int, tenant: str = "local",
+                 key: Optional[str] = None):
+        self.host, self.port, self.tenant, self.key = host, port, tenant, key
+        self._store: Optional[SummaryStore] = None
+
+    def _auth(self, doc_id: str) -> str:
+        if self.key is None:
+            return ""
+        return (
+            f"tenant={self.tenant}"
+            f"&token={TenantManager.mint(self.tenant, doc_id, self.key)}"
+        )
+
+    def connect(self, doc_id: str, mode: str = "write", from_seq: int = 0):
+        token = (
+            TenantManager.mint(self.tenant, doc_id, self.key)
+            if self.key
+            else ""
+        )
+        return NetworkConnection(
+            self.host, self.port, doc_id, self.tenant, token, mode, from_seq
+        )
+
+    def get_deltas(self, doc_id: str, from_seq: int = 0,
+                   to_seq: Optional[int] = None):
+        q = f"from={from_seq}" + (f"&to={to_seq}" if to_seq is not None else "")
+        auth = self._auth(doc_id)
+        if auth:
+            q += "&" + auth
+        url = f"http://{self.host}:{self.port}/deltas/{doc_id}?{q}"
+        with urlopen(url, timeout=10) as r:
+            return [from_jsonable(m) for m in json.loads(r.read())]
+
+    @property
+    def store(self) -> SummaryStore:
+        if self._store is None:
+            self._store = SummaryStore(
+                backend=RestBlobBackend(
+                    f"http://{self.host}:{self.port}", self._auth("")
+                )
+            )
+        return self._store
+
+
+class NetworkDocumentServiceFactory:
+    """IDocumentServiceFactory over fluid-net:// URLs."""
+
+    def __init__(self, key: Optional[str] = None):
+        self.key = key
+
+    def create_document_service(self, url: str):
+        from fluidframework_tpu.drivers.local_driver import LocalDocumentService
+
+        host, port, tenant, doc = parse_url(url)
+        svc = NetworkFluidService(host, port, tenant, self.key)
+        return LocalDocumentService(svc, doc)

@@ -70,3 +70,9 @@ def encode_value(value: Any) -> bytes:
 
 def decode_value(data: bytes) -> Any:
     return _from_jsonable(json.loads(data.decode()))
+
+
+# Public aliases for transports that embed protocol objects inside their own
+# JSON envelopes (the websocket front door / network driver).
+to_jsonable = _to_jsonable
+from_jsonable = _from_jsonable

@@ -1,0 +1,332 @@
+"""Network front door — the alfred/tinylicious equivalent.
+
+Reference: alfred exposes the live op stream over socket.io websockets
+(``connect_document``/``submitOp``/``submitSignal``,
+``lambdas/src/alfred/index.ts:197,486,524``) and REST routes for historical
+deltas and documents (``routerlicious-base/src/alfred/routes/api``), with
+riddler validating per-tenant HMAC-signed tokens (``riddler/``). Storage
+(historian) serves content-addressed blobs over REST.
+
+This server fronts any in-proc ordering service (``LocalFluidService`` or
+the partitioned-lambda ``PipelineFluidService``) with the same three
+surfaces, stdlib-only:
+
+- WebSocket (RFC 6455, :mod:`wsproto`): ``connect_document`` handshake ->
+  ``connect_document_success{client_id, initial_summary}``; ``submitOp``;
+  ``submitSignal``; server pushes ``op``/``signal``/``nack`` frames.
+- REST: ``GET /deltas/{doc}?from=&to=`` (delta storage),
+  ``POST /blobs`` / ``GET|HEAD /blobs/{handle}`` (summary storage).
+- Tenant auth: HMAC-SHA256 token over (tenant, doc) with the tenant's
+  secret key — the riddler contract without JWT ceremony.
+
+All service access happens on the asyncio loop thread, so the wrapped
+service needs no locking (the reference equivalently serializes per-socket
+processing on the Node event loop).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac
+import json
+import secrets
+import threading
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from fluidframework_tpu.service import wsproto
+from fluidframework_tpu.service.codec import from_jsonable, to_jsonable
+from fluidframework_tpu.service.local_server import LocalFluidService
+
+
+class TenantManager:
+    """Riddler equivalent: tenant registry + HMAC token mint/validate."""
+
+    def __init__(self) -> None:
+        self._keys: Dict[str, str] = {}
+
+    def register(self, tenant_id: str, key: Optional[str] = None) -> str:
+        key = key or secrets.token_hex(16)
+        self._keys[tenant_id] = key
+        return key
+
+    @staticmethod
+    def mint(tenant_id: str, doc_id: str, key: str) -> str:
+        msg = f"{tenant_id}:{doc_id}".encode()
+        return hmac.new(key.encode(), msg, hashlib.sha256).hexdigest()
+
+    def validate(self, tenant_id: str, doc_id: str, token: str) -> bool:
+        key = self._keys.get(tenant_id)
+        if key is None:
+            return False
+        return hmac.compare_digest(self.mint(tenant_id, doc_id, key), token)
+
+
+class _Session:
+    """One websocket client: its service connection + outbound writer."""
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self.writer = writer
+        self.conn = None  # service connection once connect_document succeeds
+        self.doc_id: Optional[str] = None
+
+
+class FluidNetworkServer:
+    """TCP server hosting the websocket + REST front door in a daemon
+    thread. ``service`` defaults to a fresh ``LocalFluidService``; pass a
+    ``PipelineFluidService`` to run the full partitioned-lambda pipeline
+    behind real sockets. ``tenants=None`` runs open (no auth), the local
+    tinylicious mode."""
+
+    def __init__(
+        self,
+        service=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        tenants: Optional[TenantManager] = None,
+    ):
+        self.service = service if service is not None else LocalFluidService()
+        self.host = host
+        self.port = port
+        self.tenants = tenants
+        self._sessions: List[_Session] = []
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> Tuple[str, int]:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        assert self._started.wait(10), "server failed to start"
+        return self.host, self.port
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            self._server = await asyncio.start_server(
+                self._handle, self.host, self.port
+            )
+            self.port = self._server.sockets[0].getsockname()[1]
+            self._started.set()
+
+        self._loop.run_until_complete(boot())
+        try:
+            self._loop.run_forever()
+        finally:
+            self._loop.close()
+
+    def stop(self) -> None:
+        if self._loop is None:
+            return
+
+        async def shutdown():
+            for s in list(self._sessions):
+                self._close_session(s)
+            if self._server is not None:
+                self._server.close()
+            self._loop.stop()
+
+        asyncio.run_coroutine_threadsafe(shutdown(), self._loop)
+        if self._thread is not None:
+            self._thread.join(5)
+
+    # -- connection handling ------------------------------------------------
+
+    async def _handle(self, reader: asyncio.StreamReader, writer) -> None:
+        try:
+            data = b""
+            while True:
+                chunk = await reader.read(65536)
+                if not chunk:
+                    return
+                data += chunk
+                head = wsproto.read_http_head(data)
+                if head is not None:
+                    break
+            request_line, headers, rest = head
+            method, path, _ = request_line.decode().split(" ", 2)
+            if headers.get("upgrade", "").lower() == "websocket":
+                await self._websocket(reader, writer, headers, rest)
+            else:
+                await self._rest(reader, writer, method, path, headers, rest)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    # -- REST (delta storage + blob storage) --------------------------------
+
+    async def _rest(self, reader, writer, method, path, headers, body) -> None:
+        need = int(headers.get("content-length", "0")) - len(body)
+        while need > 0:
+            chunk = await reader.read(need)
+            if not chunk:
+                break
+            body += chunk
+            need -= len(chunk)
+        url = urlparse(path)
+        parts = [p for p in url.path.split("/") if p]
+        query = {k: v[0] for k, v in parse_qs(url.query).items()}
+
+        def reply(status: int, payload: bytes = b"", ctype="application/json"):
+            writer.write(
+                (
+                    f"HTTP/1.1 {status} X\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(payload)}\r\n"
+                    "Connection: close\r\n\r\n"
+                ).encode()
+                + payload
+            )
+
+        # Delta routes are doc-scoped; blob routes use a storage-scope token
+        # (minted for the empty doc id), since handles aren't per-document.
+        scope = parts[1] if len(parts) > 1 and parts[0] == "deltas" else ""
+        if not self._authorized(query, doc_id=scope):
+            reply(403, b'{"error": "invalid token"}')
+            return
+        if method == "POST" and parts == ["blobs"]:
+            handle = self.service.store.put_blob(body)
+            reply(201, json.dumps({"handle": handle}).encode())
+        elif method in ("GET", "HEAD") and len(parts) == 2 and parts[0] == "blobs":
+            if self.service.store.has(parts[1]):
+                data = b"" if method == "HEAD" else self.service.store.get_blob(parts[1])
+                reply(200, data, ctype="application/octet-stream")
+            else:
+                reply(404)
+        elif method == "GET" and len(parts) == 2 and parts[0] == "deltas":
+            msgs = self.service.get_deltas(
+                parts[1],
+                from_seq=int(query.get("from", 0)),
+                to_seq=int(query["to"]) if "to" in query else None,
+            )
+            reply(200, json.dumps([to_jsonable(m) for m in msgs]).encode())
+        else:
+            reply(404, b'{"error": "not found"}')
+        await writer.drain()
+
+    def _authorized(self, params: dict, doc_id: str) -> bool:
+        if self.tenants is None:
+            return True
+        return self.tenants.validate(
+            params.get("tenant", ""), doc_id, params.get("token", "")
+        )
+
+    # -- websocket op channel ------------------------------------------------
+
+    async def _websocket(self, reader, writer, headers, rest: bytes) -> None:
+        writer.write(wsproto.server_handshake_response(headers))
+        await writer.drain()
+        session = _Session(writer)
+        self._sessions.append(session)
+        decoder = wsproto.FrameDecoder()
+        frames = decoder.feed(rest)
+        try:
+            while True:
+                for opcode, payload in frames:
+                    if opcode == wsproto.OP_CLOSE:
+                        return
+                    if opcode == wsproto.OP_PING:
+                        writer.write(
+                            wsproto.encode_frame(wsproto.OP_PONG, payload)
+                        )
+                        continue
+                    if opcode != wsproto.OP_TEXT:
+                        continue
+                    self._on_message(session, json.loads(payload.decode()))
+                self._drain_all()
+                await writer.drain()
+                chunk = await reader.read(65536)
+                if not chunk:
+                    return
+                frames = decoder.feed(chunk)
+        finally:
+            self._close_session(session)
+            self._drain_all()
+
+    def _close_session(self, session: _Session) -> None:
+        if session in self._sessions:
+            self._sessions.remove(session)
+        if session.conn is not None:
+            self.service.disconnect(session.doc_id, session.conn.client_id)
+            session.conn = None
+
+    def _send(self, session: _Session, obj: dict) -> None:
+        session.writer.write(
+            wsproto.encode_frame(
+                wsproto.OP_TEXT, json.dumps(obj).encode()
+            )
+        )
+
+    def _on_message(self, session: _Session, msg: dict) -> None:
+        t = msg.get("type")
+        if t == "connect_document":
+            if session.conn is not None:
+                # One document connection per socket: releasing the old one
+                # implicitly here would leak quorum entries on client bugs.
+                self._send(session, {"type": "connect_document_error",
+                                     "error": "already connected"})
+                return
+            doc_id = msg["doc"]
+            if not self._authorized(msg, doc_id):
+                self._send(session, {"type": "connect_document_error",
+                                     "error": "invalid token"})
+                return
+            try:
+                conn = self.service.connect(
+                    doc_id, msg.get("mode", "write"), msg.get("from_seq", 0)
+                )
+            except ConnectionError as e:
+                self._send(session, {"type": "connect_document_error",
+                                     "error": str(e)})
+                return
+            session.conn = conn
+            session.doc_id = doc_id
+            self._send(
+                session,
+                {
+                    "type": "connect_document_success",
+                    "client_id": conn.client_id,
+                    "initial_summary": list(conn.initial_summary)
+                    if conn.initial_summary
+                    else None,
+                },
+            )
+        elif t == "submitOp" and session.conn is not None:
+            session.conn.submit(from_jsonable(msg["op"]))
+        elif t == "submitSignal" and session.conn is not None:
+            session.conn.submit_signal(msg.get("content"))
+        elif t == "disconnect" and session.conn is not None:
+            self._close_session(session)
+
+    def _drain_all(self) -> None:
+        """Forward anything the service put in per-connection queues since
+        the last drain (the broadcaster role at the socket layer)."""
+        for s in self._sessions:
+            if s.conn is None:
+                continue
+            for m in s.conn.take_inbox():
+                self._send(s, {"type": "op", "msg": to_jsonable(m)})
+            sigs, s.conn.signals[:] = list(s.conn.signals), []
+            for sig in sigs:
+                self._send(
+                    s,
+                    {
+                        "type": "signal",
+                        "client_id": sig.client_id,
+                        "num": sig.client_connection_number,
+                        "content": sig.content,
+                    },
+                )
+            nacks, s.conn.nacks[:] = list(s.conn.nacks), []
+            for nk in nacks:
+                self._send(s, {"type": "nack", "nack": to_jsonable(nk)})
