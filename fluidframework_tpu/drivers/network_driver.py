@@ -85,6 +85,8 @@ class NetworkConnection:
         self.on_nack: Optional[Callable[[NackMessage], None]] = None
         self.initial_summary: Optional[tuple] = None
         self.client_id: int = -1
+        self.join_seq: int = 0
+        self.conn_no: int = 0
         self.closed = False
         self._lock = threading.Lock()
         self._connected = threading.Event()
@@ -175,6 +177,8 @@ class NetworkConnection:
         t = msg.get("type")
         if t == "connect_document_success":
             self.client_id = msg["client_id"]
+            self.join_seq = msg.get("join_seq", 0)
+            self.conn_no = msg.get("conn_no", 0)
             if msg.get("initial_summary"):
                 self.initial_summary = tuple(msg["initial_summary"])
             self._connected.set()

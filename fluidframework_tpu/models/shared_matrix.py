@@ -34,7 +34,9 @@ from fluidframework_tpu.protocol.constants import (
 from fluidframework_tpu.protocol.types import SequencedDocumentMessage
 from fluidframework_tpu.runtime.shared_object import SharedObject
 
-_ORIG_STRIDE = 1 << 20
+# Axis-run identity: conn_no * stride + per-connection counter (slots
+# recycle; the connection ordinal never does).
+_MINT_STRIDE = 1 << 14
 
 
 class _PermutationVector:
@@ -72,6 +74,28 @@ class SharedMatrix(SharedObject):
         self._cells: Dict[Tuple[tuple, tuple], Any] = {}
         self._cell_pending: Dict[Tuple[tuple, tuple], int] = {}
         self._lseq = 0
+        self._mint = 0  # per-connection axis-run id counter
+
+    def on_reconnect(self, new_client_id: int) -> None:
+        """Adopt the new client slot on both axis kernels and restamp
+        pending rows (see SharedString.on_reconnect: rows that exist only
+        on this replica must not match a recycled slot's next holder)."""
+        import jax.numpy as jnp
+
+        self._mint = 0
+        for vec in (self._rows, self._cols):
+            st = vec.state
+            pending_ins = st.seq == UNASSIGNED_SEQ
+            old_bit = jnp.int32(1) << jnp.clip(st.self_client, 0, 31)
+            new_bit = jnp.int32(1) << jnp.clip(jnp.int32(new_client_id), 0, 31)
+            pending_rem = st.rlseq > 0
+            vec.state = st._replace(
+                client=jnp.where(pending_ins, new_client_id, st.client),
+                rbits=jnp.where(
+                    pending_rem, (st.rbits & ~old_bit) | new_bit, st.rbits
+                ),
+                self_client=jnp.int32(new_client_id),
+            )
 
     def attach(self, runtime) -> None:
         super().attach(runtime)
@@ -116,9 +140,11 @@ class SharedMatrix(SharedObject):
         self._insert_axis("col", pos, count)
 
     def _insert_axis(self, axis: str, pos: int, count: int) -> None:
-        assert 0 < count < _ORIG_STRIDE
+        assert 0 < count < _MINT_STRIDE
         self._lseq += 1
-        orig = self.client_id * _ORIG_STRIDE + self._lseq
+        self._mint += 1
+        assert self._mint < _MINT_STRIDE
+        orig = self.conn_no * _MINT_STRIDE + self._mint
         row = E.insert(
             pos, orig, count, seq=UNASSIGNED_SEQ,
             client=self.client_id, lseq=self._lseq,

@@ -37,7 +37,10 @@ from fluidframework_tpu.protocol.constants import (
 from fluidframework_tpu.protocol.types import SequencedDocumentMessage
 from fluidframework_tpu.runtime.shared_object import SharedObject
 
-_ORIG_STRIDE = 1 << 20  # content ids: client_slot * stride + lseq
+# Content ids: conn_no * stride + per-connection mint counter. Scoped to the
+# never-recycled connection ordinal — client slots recycle, and a recycled
+# slot must not overwrite the previous holder's still-live payloads.
+_MINT_STRIDE = 1 << 14
 
 
 def _delta_from_contents(c: dict) -> dict:
@@ -65,8 +68,16 @@ class SharedString(SharedObject):
         self._state = None  # created on attach (needs client slot)
         self._payloads: dict = {}
         self._lseq = 0
+        self._mint = 0  # per-connection content-id counter
         self._interval_collections: dict = {}
         self._local_refs: list = []
+
+    def _mint_orig(self) -> int:
+        self._mint += 1
+        assert self._mint < _MINT_STRIDE, (
+            "per-connection content-id space exhausted; reconnect to refresh"
+        )
+        return self.conn_no * _MINT_STRIDE + self._mint
 
     def attach(self, runtime) -> None:
         super().attach(runtime)
@@ -164,7 +175,7 @@ class SharedString(SharedObject):
     def insert_text(self, pos: int, text: str) -> None:
         assert len(text) > 0, "empty insert"
         self._lseq += 1
-        orig = self.client_id * _ORIG_STRIDE + self._lseq
+        orig = self._mint_orig()
         self._payloads[orig] = text
         row = E.insert(
             pos, orig, len(text), seq=UNASSIGNED_SEQ,
@@ -326,9 +337,34 @@ class SharedString(SharedObject):
     # -- reconnect rebase (reference regeneratePendingOp, client.ts:917) ------
 
     def on_reconnect(self, new_client_id: int) -> None:
+        """Adopt the new connection's client slot.
+
+        Pending rows must be restamped from the old slot to the new one:
+        client slots recycle, and rows that exist only on this replica
+        (unacked local inserts / removes) would otherwise satisfy the
+        kernel's own-insert fast path (``client == clientn``) or the
+        removers bitmask for the slot's NEXT holder — making remote ops
+        resolve positions differently here than on every other replica."""
         import jax.numpy as jnp
 
-        self._state = self._state._replace(self_client=jnp.int32(new_client_id))
+        from fluidframework_tpu.protocol.constants import UNASSIGNED_SEQ
+
+        self._mint = 0  # content ids scope to the connection ordinal
+        st = self._state
+        old = st.self_client
+        pending_ins = st.seq == UNASSIGNED_SEQ
+        new_client = jnp.where(pending_ins, new_client_id, st.client)
+        pending_rem = st.rlseq > 0
+        old_bit = jnp.int32(1) << jnp.clip(old, 0, 31)
+        new_bit = jnp.int32(1) << jnp.clip(jnp.int32(new_client_id), 0, 31)
+        new_rbits = jnp.where(
+            pending_rem, (st.rbits & ~old_bit) | new_bit, st.rbits
+        )
+        self._state = st._replace(
+            client=new_client,
+            rbits=new_rbits,
+            self_client=jnp.int32(new_client_id),
+        )
 
     def begin_resubmit(self) -> None:
         # All regenerations in one batch read the reconnect-time state;
@@ -370,14 +406,24 @@ class SharedString(SharedObject):
                     ]
                     for i in run.rows
                 )
+                # Each run is a fresh wire insert and needs its own payload:
+                # re-sending the original orig would make every replica
+                # overwrite it with THIS run's text while other runs' rows
+                # still slice it. Local rows restamp onto the new payload.
+                orig = self._mint_orig()
+                self._payloads[orig] = text
                 self._restamp("lseq", run.rows, self._lseq)
+                self._restamp("orig", run.rows, orig)
+                offs = np.asarray(self._state.off).copy()
+                off = 0
+                for i in run.rows:
+                    offs[i] = off
+                    off += int(h.length[i])
+                import jax.numpy as jnp
+
+                self._state = self._state._replace(off=jnp.asarray(offs))
                 self.submit_local_message(
-                    {
-                        "k": "ins",
-                        "pos": run.pos,
-                        "text": text,
-                        "orig": contents["orig"],
-                    },
+                    {"k": "ins", "pos": run.pos, "text": text, "orig": orig},
                     {"kind": "insert", "lseq": self._lseq},
                 )
         elif kind == "remove":

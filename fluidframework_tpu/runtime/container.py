@@ -63,7 +63,10 @@ class ContainerRuntime:
         self.connected = True
         self.connection = service.connect(doc_id, mode)
         self.client_id = self.connection.client_id
-        self._my_ids = {self.client_id}  # this + prior connections' ids
+        self._join_seq = getattr(self.connection, "join_seq", 0)
+        self.conn_no = getattr(self.connection, "conn_no", 0) or (
+            self.client_id + 1  # mock services without ordinals don't recycle
+        )
         self._offline: list = []  # ops authored while disconnected
         self.channels: Dict[str, SharedObject] = {}
         self.ref_seq = 0  # last processed sequence number
@@ -285,6 +288,13 @@ class ContainerRuntime:
             self._send_batch(batch)
         return len(msgs)
 
+    def _is_own_echo(self, msg: SequencedDocumentMessage) -> bool:
+        """True iff this sequenced message is this connection's own op."""
+        return (
+            msg.client_id == self.client_id
+            and msg.sequence_number > self._join_seq
+        )
+
     def _process_one(self, msg: SequencedDocumentMessage) -> None:
         assert (
             msg.sequence_number == self.ref_seq + 1
@@ -298,8 +308,12 @@ class ContainerRuntime:
             self._open_batch = False
         # Every sequenced message from this client consumed a server-side
         # clientSequenceNumber slot — PROPOSE/NOOP/SUMMARIZE included — so
-        # nack recovery must never reuse a number at or below it.
-        if msg.client_id in self._my_ids:
+        # nack recovery must never reuse a number at or below it. Identity
+        # is (current connection id AND sequenced after our join): client
+        # slots recycle, so a historical id may belong to a previous holder
+        # whose traffic all precedes our ClientJoin, and everything from our
+        # own prior connections fully drained before we disconnected.
+        if self._is_own_echo(msg):
             self._last_acked_cseq = max(
                 self._last_acked_cseq, msg.client_sequence_number
             )
@@ -314,6 +328,9 @@ class ContainerRuntime:
             self.quorum_members[cid] = {
                 "client_id": cid,
                 "mode": detail.get("mode", "write"),
+                # Join order for election: slot numbers recycle, so "oldest
+                # client" is smallest join seq, not smallest slot.
+                "join_seq": msg.sequence_number,
             }
         elif msg.type == MessageType.CLIENT_LEAVE:
             self.quorum_members.pop(msg.contents, None)
@@ -326,7 +343,7 @@ class ContainerRuntime:
             # attach before any op on the channel guarantees a target exists
             # on every replica.
             cid, type_name = msg.contents["id"], msg.contents["type"]
-            if msg.client_id in self._my_ids:
+            if self._is_own_echo(msg):
                 self._pending_attaches.pop(cid, None)
             if cid not in self.channels:
                 self._realize_channel(cid, type_name, msg.contents.get("root", False))
@@ -345,7 +362,7 @@ class ContainerRuntime:
                 f"{self._unrealized.get(address)!r} — register the type "
                 "before loading this document"
             )
-            local = msg.client_id in self._my_ids
+            local = self._is_own_echo(msg)
             local_metadata = None
             if local:
                 assert self.pending, "ack with no pending op"
@@ -389,11 +406,19 @@ class ContainerRuntime:
         edits through each channel's resubmit path (reference
         regeneratePendingOp / reSubmitCore)."""
         assert not self.connected, "already connected"
+        # Unflushed outbox entries authored while offline are offline edits:
+        # sweep them into the resubmit buffer now, or the catch-up flush
+        # below would send them raw (stale client id / local seqs), bypassing
+        # the per-channel regenerate path.
+        self.flush()
         self.connection = self._service.connect(
             self.doc_id, self._mode, from_seq=self.ref_seq
         )
         self.client_id = self.connection.client_id
-        self._my_ids.add(self.client_id)
+        self._join_seq = getattr(self.connection, "join_seq", 0)
+        self.conn_no = getattr(self.connection, "conn_no", 0) or (
+            self.client_id + 1
+        )
         self.client_seq = 0  # clientSequenceNumbers are per-connection
         self._last_acked_cseq = 0
         self.connected = True

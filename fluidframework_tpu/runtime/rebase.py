@@ -58,35 +58,37 @@ def _vis(h, i: int, L: int, *, remove_strict: bool) -> int:
     return int(h.length[i])
 
 
-def regen_insert(h, L: int) -> List[RegenRun]:
-    """Regenerate a pending insert op L: one run (rows with lseq == L are
-    contiguous at perspective L), positioned at the visible prefix."""
-    rows = []
-    pos = 0
-    for i in range(int(h.count)):
-        if int(h.lseq[i]) == L and int(h.kind[i]) != KIND_FREE:
-            rows.append(i)
-        elif not rows:
-            pos += _vis(h, i, L, remove_strict=False)
-    if not rows:
-        return []
-    span = sum(int(h.length[i]) for i in rows)
-    return [RegenRun(pos=pos, span=span, rows=rows)]
+def _regen_ranges(
+    h, L: int, covered, *, remove_strict: bool, consume_covered: bool
+) -> List[RegenRun]:
+    """Gap-separated runs of covered rows with wire positions.
 
+    The regenerated runs go on the wire as SEPARATE ops applied in order, so
+    a later run's position must match the perspective remote replicas hold
+    *after the earlier runs applied*:
 
-def _regen_ranges(h, L: int, covered, *, remove_strict: bool) -> List[RegenRun]:
+    - inserts/annotates (``consume_covered=True``): an earlier run's rows are
+      visible to later ops (own pending inserts pass the kernel's
+      ``client == clientn`` fast path even before ack), at their FULL width
+      — local hiding (e.g. a not-yet-resubmitted local remove over them)
+      has not happened remotely yet;
+    - removes (``consume_covered=False``): an earlier run's rows are hidden
+      to later ops (the removers bitmask marks them at apply time), so their
+      widths must NOT advance the position.
+    """
     runs: List[RegenRun] = []
     pos = 0
     current: List[int] = []
     start = 0
     for i in range(int(h.count)):
-        v = _vis(h, i, L, remove_strict=remove_strict)
         if covered(i):
             if not current:
                 start = pos
             current.append(i)
-            pos += v
+            if consume_covered:
+                pos += int(h.length[i])
             continue
+        v = _vis(h, i, L, remove_strict=remove_strict)
         if v > 0:
             if current:
                 runs.append(
@@ -109,6 +111,19 @@ def _regen_ranges(h, L: int, covered, *, remove_strict: bool) -> List[RegenRun]:
     return runs
 
 
+def regen_insert(h, L: int) -> List[RegenRun]:
+    """Regenerate a pending insert op L: one run per gap-separated group of
+    its rows (an acked remote insert may have split them — each group needs
+    its own wire op, as the reference emits one op per pending segment)."""
+
+    def covered(i):
+        return int(h.lseq[i]) == L and int(h.kind[i]) != KIND_FREE
+
+    return _regen_ranges(
+        h, L, covered, remove_strict=False, consume_covered=True
+    )
+
+
 def regen_remove(h, L: int) -> List[RegenRun]:
     """Regenerate a pending remove op L: one range per run of rows still
     only locally removed; rows whose removal was superseded by an acked
@@ -121,7 +136,9 @@ def regen_remove(h, L: int) -> List[RegenRun]:
             and int(h.kind[i]) != KIND_FREE
         )
 
-    return _regen_ranges(h, L, covered, remove_strict=True)
+    return _regen_ranges(
+        h, L, covered, remove_strict=True, consume_covered=False
+    )
 
 
 def regen_annotate(h, L: int) -> List[RegenRun]:
@@ -135,4 +152,6 @@ def regen_annotate(h, L: int) -> List[RegenRun]:
             and int(h.kind[i]) != KIND_FREE
         )
 
-    return _regen_ranges(h, L, covered, remove_strict=False)
+    return _regen_ranges(
+        h, L, covered, remove_strict=False, consume_covered=True
+    )

@@ -36,6 +36,14 @@ class SharedObject(TypedEventEmitter, abc.ABC):
         assert self._runtime is not None, "channel not attached"
         return self._runtime.client_id
 
+    @property
+    def conn_no(self) -> int:
+        """Never-recycled per-document connection ordinal — the scope for
+        content ids (payload origs, tree cell ids). Client slots recycle, so
+        slot-scoped ids would collide with a previous holder's live content."""
+        assert self._runtime is not None, "channel not attached"
+        return self._runtime.conn_no
+
     def submit_local_message(self, contents: Any, local_metadata: Any = None) -> None:
         """Queue an op for sequencing (recorded in pending state for ack
         matching — reference SharedObjectCore.submitLocalMessage)."""

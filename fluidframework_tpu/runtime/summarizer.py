@@ -44,11 +44,14 @@ class SummarizerElection:
     @property
     def elected_client_id(self) -> Optional[int]:
         eligible = [
-            cid
+            (detail.get("join_seq", 0), cid)
             for cid, detail in self._container.quorum_members.items()
             if detail.get("mode", "write") == "write"
         ]
-        return min(eligible) if eligible else None
+        # Earliest-joined wins; slot number only tie-breaks. Slot numbers
+        # recycle, so ordering by slot would let a brand-new client that
+        # lands a low recycled slot steal the election.
+        return min(eligible)[1] if eligible else None
 
     @property
     def is_elected(self) -> bool:

@@ -194,6 +194,8 @@ class DeliDocLambda(PartitionLambda):
                 "minimum_sequence_number": cp.minimum_sequence_number,
                 "clients": cp.clients,
                 "next_slot": cp.next_slot,
+                "free_slots": cp.free_slots,
+                "connection_count": cp.connection_count,
             },
             "signals": self._signal_counter,
         }

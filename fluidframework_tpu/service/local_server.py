@@ -32,6 +32,14 @@ class LocalConnection:
     doc_id: str
     client_id: int
     service: "LocalFluidService"
+    # Sequence number of this connection's own ClientJoin: client slots
+    # recycle, so "is this message my echo" is (client_id matches AND
+    # seq > join_seq) — a previous holder's traffic is always ≤ its leave,
+    # which precedes this join.
+    join_seq: int = 0
+    # Never-recycled per-document connection ordinal (content-id scoping).
+    conn_no: int = 0
+    evicted: bool = False  # severed by idle expiry; submits are rejected
     inbox: List[SequencedDocumentMessage] = field(default_factory=list)
     signals: List[SignalMessage] = field(default_factory=list)
     nacks: List[NackMessage] = field(default_factory=list)
@@ -101,7 +109,11 @@ class LocalFluidService:
         if isinstance(res, NackMessage):
             raise ConnectionError(res.message)
         client_id = res.contents["clientId"]
-        conn = LocalConnection(doc_id=doc_id, client_id=client_id, service=self)
+        conn = LocalConnection(
+            doc_id=doc_id, client_id=client_id, service=self,
+            join_seq=res.sequence_number,
+            conn_no=res.contents.get("connNo", 0),
+        )
         # Catch-up: a fresh client gets the latest acked summary plus the op
         # tail after it; a reconnecting client resumes from where it left
         # off (reference storage.getVersions + delta fetch).
@@ -124,8 +136,32 @@ class LocalFluidService:
 
     # -- op path (alfred submitOp -> deli -> broadcaster, §3.3) --------------
 
+    def expire_idle(self, timeout_s: float, now=None) -> int:
+        """Evict clients idle past the timeout (deli ClientSequenceTimeout):
+        sequences their leaves, broadcasts them, and SEVERS the zombie
+        connections — an evicted client's slot may recycle, so it must stop
+        receiving traffic (its next holder's ops would look like echoes) and
+        must reconnect to keep editing. Returns clients evicted."""
+        n = 0
+        for doc in self.docs.values():
+            for leave in doc.sequencer.expire_idle(timeout_s, now):
+                evicted = leave.contents
+                conn = doc.connections.pop(evicted, None)
+                if conn is not None:
+                    conn.evicted = True
+                self._broadcast(doc, leave)
+                n += 1
+        return n
+
     def submit(self, doc_id: str, client_id: int, msg: DocumentMessage) -> None:
         doc = self._doc(doc_id)
+        if client_id not in doc.connections:
+            # Evicted/disconnected clients are dead to the service: the op is
+            # rejected and the client must reconnect (the reference closes
+            # the socket; this is the in-proc analog).
+            raise ConnectionError(
+                f"client {client_id} is not connected to {doc_id!r}"
+            )
         if self.trace_sampler is not None and self.trace_sampler.should_trace():
             tracing.stamp(msg.traces, "alfred", "start")
         res = doc.sequencer.ticket(client_id, msg)

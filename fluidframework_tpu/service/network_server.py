@@ -296,6 +296,8 @@ class FluidNetworkServer:
                 {
                     "type": "connect_document_success",
                     "client_id": conn.client_id,
+                    "join_seq": getattr(conn, "join_seq", 0),
+                    "conn_no": getattr(conn, "conn_no", 0),
                     "initial_summary": list(conn.initial_summary)
                     if conn.initial_summary
                     else None,

@@ -49,6 +49,8 @@ class PipelineConnection:
         self.doc_id = doc_id
         self.token = token
         self.client_id: int = -1  # set once the sequenced join arrives
+        self.join_seq: int = 0  # its sequence number (slot-recycling echo guard)
+        self.conn_no: int = 0  # never-recycled ordinal (content-id scoping)
         self.service = service
         self.inbox: List[SequencedDocumentMessage] = []
         self.signals: List[SignalMessage] = []
@@ -196,6 +198,8 @@ class PipelineFluidService:
                 and msg.contents.get("token") == token
             ):
                 conn.client_id = msg.contents["clientId"]
+                conn.join_seq = msg.sequence_number
+                conn.conn_no = msg.contents.get("connNo", 0)
                 break
         if conn.client_id < 0:
             self.rooms[doc_id].remove(conn)

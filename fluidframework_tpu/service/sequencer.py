@@ -39,6 +39,7 @@ class _ClientEntry:
     client_seq: int  # highest clientSequenceNumber seen
     can_evict: bool = True
     mode: str = "write"
+    last_seen: float = 0.0  # wall time of last op/join (idle expiry)
 
 
 @dataclass
@@ -50,6 +51,8 @@ class SequencerCheckpoint:
     minimum_sequence_number: int
     clients: List[dict] = field(default_factory=list)
     next_slot: int = 0
+    free_slots: List[List[int]] = field(default_factory=list)  # [slot, leave_seq]
+    connection_count: int = 0  # monotonic join ordinal, never recycled
 
 
 class DocumentSequencer:
@@ -61,10 +64,22 @@ class DocumentSequencer:
         self.min_seq = 0
         self.clients: Dict[int, _ClientEntry] = {}
         self._next_slot = 0
+        # Slots released by leaves, reusable once their leave seq falls at or
+        # below the collab-window floor: every stamp from the old holder is
+        # then acked and outside any perspective the kernel can be asked for,
+        # so a new holder cannot collide (deli has no cap — string client
+        # ids; the int-slot design needs recycling to live that long).
+        self._free_slots: List[List[int]] = []
+        # Slots are kernel-facing and recycle; the connection ordinal is the
+        # never-recycled identity clients scope content ids to (a recycled
+        # slot must not collide payload/cell id keyspaces).
+        self._conn_count = 0
         if checkpoint is not None:
             self.seq = checkpoint.sequence_number
             self.min_seq = checkpoint.minimum_sequence_number
             self._next_slot = checkpoint.next_slot
+            self._free_slots = [list(x) for x in checkpoint.free_slots]
+            self._conn_count = checkpoint.connection_count
             for c in checkpoint.clients:
                 self.clients[c["client_id"]] = _ClientEntry(**c)
 
@@ -77,21 +92,32 @@ class DocumentSequencer:
         1M-clients/doc cap (config.json:57) becomes MAX_WRITERS concurrent
         write slots per document in round 1.
         """
-        if self._next_slot >= MAX_WRITERS:
-            return NackMessage(
-                self.seq, 429, NackErrorType.LIMIT_EXCEEDED,
-                f"document writer slots exhausted ({MAX_WRITERS})",
-            )
-        slot = self._next_slot
-        self._next_slot += 1
+        slot = None
+        for i, (s, leave_seq) in enumerate(self._free_slots):
+            if leave_seq <= self.min_seq:
+                slot = s
+                del self._free_slots[i]
+                break
+        if slot is None:
+            if self._next_slot >= MAX_WRITERS:
+                return NackMessage(
+                    self.seq, 429, NackErrorType.LIMIT_EXCEEDED,
+                    f"document writer slots exhausted ({MAX_WRITERS})",
+                )
+            slot = self._next_slot
+            self._next_slot += 1
         # Join contents carry the client detail (reference ClientJoin op's
-        # IClient payload) — election needs the mode for eligibility.
+        # IClient payload) — election needs the mode for eligibility, and
+        # connNo is the never-recycled ordinal content ids scope to.
+        self._conn_count += 1
         msg = self._sequence_system(
-            MessageType.CLIENT_JOIN, contents={"clientId": slot, "mode": mode}
+            MessageType.CLIENT_JOIN,
+            contents={"clientId": slot, "mode": mode, "connNo": self._conn_count},
         )
         # The new client's collab-window floor is the join op itself.
         self.clients[slot] = _ClientEntry(
-            client_id=slot, ref_seq=msg.sequence_number, client_seq=0, mode=mode
+            client_id=slot, ref_seq=msg.sequence_number, client_seq=0, mode=mode,
+            last_seen=time.time(),
         )
         return msg
 
@@ -99,7 +125,29 @@ class DocumentSequencer:
         if client_id not in self.clients:
             return None
         del self.clients[client_id]
-        return self._sequence_system(MessageType.CLIENT_LEAVE, contents=client_id)
+        msg = self._sequence_system(MessageType.CLIENT_LEAVE, contents=client_id)
+        self._free_slots.append([client_id, msg.sequence_number])
+        return msg
+
+    def expire_idle(
+        self, timeout_s: float, now: Optional[float] = None
+    ) -> List[SequencedDocumentMessage]:
+        """Evict clients idle past ``timeout_s`` (reference deli expires
+        stale clients via ClientSequenceTimeout so a crashed client that
+        never sent leave cannot pin the MSN forever). Returns the sequenced
+        leave messages to broadcast."""
+        now = time.time() if now is None else now
+        stale = [
+            c.client_id
+            for c in self.clients.values()
+            if c.can_evict and now - c.last_seen > timeout_s
+        ]
+        out = []
+        for cid in stale:
+            msg = self.leave(cid)
+            if msg is not None:
+                out.append(msg)
+        return out
 
     # -- the ticket loop ------------------------------------------------------
 
@@ -136,6 +184,7 @@ class DocumentSequencer:
             )
         entry.client_seq = msg.client_sequence_number
         entry.ref_seq = msg.reference_sequence_number
+        entry.last_seen = time.time()
 
         # Sampled op tracing: if the front door stamped this message, the
         # sequencer appends its own span (reference deli/lambda.ts:1451).
@@ -195,4 +244,6 @@ class DocumentSequencer:
             minimum_sequence_number=self.min_seq,
             clients=[c.__dict__.copy() for c in self.clients.values()],
             next_slot=self._next_slot,
+            free_slots=[list(x) for x in self._free_slots],
+            connection_count=self._conn_count,
         )

@@ -1,0 +1,190 @@
+"""Stress/load harness with fault injection.
+
+Reference: ``packages/test/test-service-load`` — configurable client
+count/op rates (``testConfig.json`` profiles, e.g. the ci profile's 120
+clients x 10k ops), random client kill/offline windows via
+``faultInjectionDriver.ts``, and end-of-run convergence verification.
+
+A :class:`LoadProfile` drives N ``ContainerRuntime`` clients against any
+service (in-proc, partitioned pipeline, or network sockets — the harness
+only needs the ``connect``/``store`` duck surface). Faults are offline
+windows: a client disconnects mid-run, keeps editing (buffered for
+resubmission), then reconnects and rebases. The run report carries
+throughput and fault counts; the final assertion is the only one that
+matters — every replica converged to identical channel state.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from fluidframework_tpu.models.shared_map import SharedMap
+from fluidframework_tpu.models.shared_string import SharedString
+from fluidframework_tpu.runtime.container import ContainerRuntime
+
+ALPHABET = "abcdefghijklmnopqrstuvwxyz"
+
+
+@dataclass
+class LoadProfile:
+    """The testConfig.json analog."""
+
+    n_clients: int = 4
+    total_ops: int = 400
+    seed: int = 0
+    # Probability per scheduled op that the acting client starts an offline
+    # window (disconnect -> keep editing -> reconnect after `offline_ops`
+    # further global steps).
+    fault_rate: float = 0.0
+    offline_ops: int = 20
+    flush_every: int = 3
+    process_every: int = 5
+    string_weight: float = 0.7  # vs map ops
+    doc_id: str = "load-doc"
+
+
+@dataclass
+class LoadReport:
+    ops_submitted: int = 0
+    faults_injected: int = 0
+    reconnects: int = 0
+    nacks: int = 0
+    elapsed_s: float = 0.0
+    converged: bool = False
+    final_text_len: int = 0
+    texts: list = field(default_factory=list)  # per-replica, for divergence triage
+    annotations: list = field(default_factory=list)
+
+    @property
+    def ops_per_sec(self) -> float:
+        return self.ops_submitted / self.elapsed_s if self.elapsed_s else 0.0
+
+
+class LoadRunner:
+    """Runs one profile against one service instance."""
+
+    def __init__(self, service, profile: LoadProfile,
+                 service_for_client: Optional[Callable[[int], object]] = None):
+        self.service = service
+        self.profile = profile
+        # Network runs need one client-side facade per client; in-proc runs
+        # share the service object.
+        self._svc_for = service_for_client or (lambda i: service)
+
+    def run(self) -> LoadReport:
+        p = self.profile
+        rng = np.random.default_rng(p.seed)
+        report = LoadReport()
+        t0 = time.monotonic()
+
+        runtimes: List[ContainerRuntime] = [
+            ContainerRuntime(
+                self._svc_for(i),
+                p.doc_id,
+                channels=(SharedString("text"), SharedMap("map")),
+            )
+            for i in range(p.n_clients)
+        ]
+        for rt in runtimes:
+            rt.on_nack_count = 0
+        offline_until: dict = {}  # runtime index -> step to reconnect at
+
+        def one_op(rt: ContainerRuntime) -> None:
+            s = rt.get_channel("text")
+            length = len(s.get_text())
+            if rng.random() < p.string_weight:
+                if length > 4 and rng.random() < 0.4:
+                    a = int(rng.integers(0, length - 1))
+                    b = min(length, a + int(rng.integers(1, 4)))
+                    if rng.random() < 0.3:
+                        s.annotate(a, b, int(rng.integers(1, 9)))
+                    else:
+                        s.remove_range(a, b)
+                else:
+                    pos = int(rng.integers(0, length + 1))
+                    txt = "".join(
+                        rng.choice(list(ALPHABET), int(rng.integers(1, 4)))
+                    )
+                    s.insert_text(pos, txt)
+            else:
+                m = rt.get_channel("map")
+                m.set(str(int(rng.integers(0, 12))), int(rng.integers(0, 100)))
+
+        for step in range(p.total_ops):
+            # Scheduled reconnects first.
+            for i, until in list(offline_until.items()):
+                if step >= until:
+                    runtimes[i].reconnect()
+                    report.reconnects += 1
+                    del offline_until[i]
+
+            i = int(rng.integers(0, p.n_clients))
+            rt = runtimes[i]
+            one_op(rt)
+            report.ops_submitted += 1
+
+            online = i not in offline_until
+            if online and p.fault_rate > 0 and rng.random() < p.fault_rate:
+                # Offline window: drain in-flight state, then drop.
+                rt.flush()
+                self._settle(runtimes, offline_until)
+                rt.process_incoming()
+                rt.disconnect()
+                offline_until[i] = step + 1 + int(rng.integers(1, p.offline_ops))
+                report.faults_injected += 1
+                continue
+            if online and step % p.flush_every == 0:
+                rt.flush()
+            if step % p.process_every == 0:
+                self._settle(runtimes, offline_until)
+
+        # Drain: reconnect everyone, flush, process to quiescence.
+        for i in sorted(offline_until):
+            runtimes[i].reconnect()
+            report.reconnects += 1
+        offline_until.clear()
+        for rt in runtimes:
+            rt.flush()
+        deadline = time.monotonic() + 30
+        quiet = 0
+        while quiet < 3 and time.monotonic() < deadline:
+            progressed = False
+            for rt in runtimes:
+                if rt.process_incoming():
+                    progressed = True
+                rt.flush()
+            if progressed:
+                quiet = 0
+            else:
+                quiet += 1
+                time.sleep(0.005)
+
+        texts = [rt.get_channel("text").get_text() for rt in runtimes]
+        annos = [rt.get_channel("text").annotations() for rt in runtimes]
+        maps = [
+            {k: rt.get_channel("map").get(k) for k in rt.get_channel("map").keys()}
+            for rt in runtimes
+        ]
+        report.texts = texts
+        report.annotations = annos
+        report.converged = (
+            all(t == texts[0] for t in texts)
+            and all(a == annos[0] for a in annos)
+            and all(m == maps[0] for m in maps)
+        )
+        report.final_text_len = len(texts[0])
+        report.nacks = sum(len(rt.connection.nacks) for rt in runtimes)
+        report.elapsed_s = time.monotonic() - t0
+        for rt in runtimes:
+            if rt.connected:
+                rt.disconnect()
+        return report
+
+    def _settle(self, runtimes, offline_until) -> None:
+        for j, other in enumerate(runtimes):
+            if j not in offline_until:
+                other.process_incoming()

@@ -23,7 +23,10 @@ from fluidframework_tpu.runtime.shared_object import SharedObject
 from fluidframework_tpu.tree import marks as M
 from fluidframework_tpu.tree.edit_manager import Commit, EditManager
 
-_ID_STRIDE = 1 << 20
+# Cell ids scope to the never-recycled connection ordinal (client slots
+# recycle; a recycled slot minting slot-scoped ids would collide with the
+# previous holder's still-live cells, breaking identity-based merge).
+_ID_STRIDE = 1 << 14
 
 
 class SharedTree(SharedObject):
@@ -38,6 +41,7 @@ class SharedTree(SharedObject):
 
     def on_reconnect(self, new_client_id: int) -> None:
         self._em.set_session(new_client_id)
+        self._counter = 0  # cell ids re-scope to the new connection ordinal
 
     # -- reads ----------------------------------------------------------------
 
@@ -53,7 +57,10 @@ class SharedTree(SharedObject):
         cells = []
         for v in values:
             self._counter += 1
-            cells.append((self.client_id * _ID_STRIDE + self._counter, v))
+            assert self._counter < _ID_STRIDE, (
+                "per-connection cell-id space exhausted; reconnect to refresh"
+            )
+            cells.append((self.conn_no * _ID_STRIDE + self._counter, v))
         return cells
 
     def _author(self, change: M.Changeset) -> None:

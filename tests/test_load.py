@@ -1,0 +1,126 @@
+"""Stress/load profiles (CI-sized) over every service transport.
+
+The test-service-load analog (SURVEY.md §4.7): randomized op soup from N
+clients with offline-window fault injection, asserting convergence at the
+end. Profiles here are scaled for CI; the same harness runs the big
+profiles out-of-band.
+"""
+
+import pytest
+
+from fluidframework_tpu.drivers.network_driver import NetworkFluidService
+from fluidframework_tpu.service.local_server import LocalFluidService
+from fluidframework_tpu.service.network_server import FluidNetworkServer
+from fluidframework_tpu.service.pipeline import PipelineFluidService
+from fluidframework_tpu.testing.load import LoadProfile, LoadRunner
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_load_local_with_faults(seed):
+    profile = LoadProfile(
+        n_clients=6, total_ops=400, seed=seed, fault_rate=0.02, offline_ops=25
+    )
+    report = LoadRunner(LocalFluidService(), profile).run()
+    assert report.converged, f"divergence: {report}"
+    assert report.ops_submitted == 400
+    assert report.faults_injected > 0, "profile expected faults to fire"
+    assert report.reconnects == report.faults_injected
+
+
+def test_load_pipeline_service():
+    profile = LoadProfile(
+        n_clients=4, total_ops=200, seed=7, fault_rate=0.015, offline_ops=15,
+        doc_id="pipe-load",
+    )
+    report = LoadRunner(
+        PipelineFluidService(n_partitions=2), profile
+    ).run()
+    assert report.converged, f"divergence: {report}"
+
+
+def test_load_over_network_sockets():
+    srv = FluidNetworkServer()
+    srv.start()
+    try:
+        profile = LoadProfile(
+            n_clients=3, total_ops=120, seed=3, fault_rate=0.01,
+            offline_ops=10, doc_id="net-load",
+        )
+        runner = LoadRunner(
+            None,
+            profile,
+            service_for_client=lambda i: NetworkFluidService(
+                "127.0.0.1", srv.port
+            ),
+        )
+        report = runner.run()
+        assert report.converged, f"divergence: {report}"
+    finally:
+        srv.stop()
+
+
+def test_slot_recycling_under_reconnect_churn():
+    """Reconnect churn far beyond MAX_WRITERS must not exhaust a document:
+    slots recycle once their leave falls below the collab-window floor."""
+    from fluidframework_tpu.models.shared_string import SharedString
+    from fluidframework_tpu.runtime.container import ContainerRuntime
+
+    svc = LocalFluidService()
+    anchor = ContainerRuntime(svc, "churn", channels=(SharedString("t"),))
+    rt = ContainerRuntime(svc, "churn", channels=(SharedString("t"),))
+    for i in range(60):  # far beyond the 31-slot bitmask width
+        rt.get_channel("t").insert_text(0, "x")
+        rt.flush()
+        rt.process_incoming()
+        anchor.process_incoming()
+        anchor.send_noop()  # keeps the floor advancing past leaves
+        anchor.process_incoming()
+        rt.disconnect()
+        rt.reconnect()
+    rt.get_channel("t").insert_text(0, "done-")
+    rt.flush()
+    rt.process_incoming()
+    anchor.process_incoming()
+    assert anchor.get_channel("t").get_text().startswith("done-")
+    assert len(anchor.get_channel("t").get_text()) == 65
+
+
+def test_idle_client_expiry_severs_and_unpins():
+    """A client that vanishes without leave is expired by the service so
+    the MSN can advance (deli ClientSequenceTimeout); the zombie connection
+    is severed — its slot may recycle, so it must stop receiving traffic
+    and its submits are rejected until it reconnects."""
+    import time
+
+    from fluidframework_tpu.protocol.types import DocumentMessage, MessageType
+
+    svc = LocalFluidService()
+    conn_a = svc.connect("doc")
+    conn_b = svc.connect("doc")
+    seq = svc.docs["doc"].sequencer
+
+    conn_a.submit(
+        DocumentMessage(1, conn_a.take_inbox()[-1].sequence_number,
+                        MessageType.OPERATION, contents=None)
+    )
+    # a stays active; b vanishes (no leave) and pins the MSN.
+    assert svc.expire_idle(timeout_s=3600) == 0, "inside timeout: no expiry"
+    time.sleep(0.3)
+    # Refresh a's activity so only b is stale past the timeout.
+    conn_a.submit(
+        DocumentMessage(2, seq.seq, MessageType.OPERATION, contents=None)
+    )
+    evicted = svc.expire_idle(timeout_s=0.2)
+    assert evicted == 1
+    assert conn_b.evicted
+    with pytest.raises(ConnectionError):
+        conn_b.submit(
+            DocumentMessage(1, seq.seq, MessageType.OPERATION, contents=None)
+        )
+    # With the zombie gone the floor advances on the next op.
+    before = seq.min_seq
+    conn_a.submit(
+        DocumentMessage(3, seq.seq, MessageType.OPERATION, contents=None)
+    )
+    assert seq.min_seq >= before
+    assert conn_b.client_id not in seq.clients

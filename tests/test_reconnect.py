@@ -152,3 +152,61 @@ def test_reconnect_farm(seed):
     texts = [s.get_text() for s in strings]
     assert all(t == texts[0] for t in texts), f"diverged: {texts}"
     assert all(s.err_flags == 0 for s in strings)
+
+
+def test_offline_remove_split_by_concurrent_insert():
+    """A pending remove whose rows get split by a concurrent remote insert
+    regenerates as MULTIPLE wire removes; later runs' positions must not
+    count earlier runs' rows (hidden by the time they apply remotely)."""
+    svc, (a, b) = setup(2)
+    sa, sb = a.get_channel("text"), b.get_channel("text")
+    sa.insert_text(0, "abcdef")
+    drain([a, b])
+
+    a.disconnect()
+    sa.remove_range(1, 5)  # offline: removes "bcde"
+    sb.insert_text(3, "XY")  # lands inside the locally-removed range
+    b.flush()
+    a.reconnect()
+    drain([a, b])
+    assert sa.get_text() == sb.get_text() == "aXYf"
+
+
+def test_recycled_slot_does_not_leak_pending_rows():
+    """Pending rows restamp to the new client slot on reconnect: a new
+    client recycling the old slot must not see this replica's unacked rows
+    through the kernel's own-insert fast path."""
+    from fluidframework_tpu.models.shared_string import SharedString
+    from fluidframework_tpu.runtime.container import ContainerRuntime
+    from fluidframework_tpu.service.local_server import LocalFluidService
+
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "doc", channels=(SharedString("text"),))
+    b = ContainerRuntime(svc, "doc", channels=(SharedString("text"),))
+    a.get_channel("text").insert_text(0, "base")
+    drain([a, b])
+    old_slot = a.client_id
+
+    a.disconnect()
+    a.get_channel("text").insert_text(0, "PP")  # pending rows, old stamp
+    # Advance the collab window past a's leave so the slot becomes
+    # recyclable, then let a new client take it.
+    b.send_noop()
+    b.process_incoming()
+    b.send_noop()
+    b.process_incoming()
+    c = ContainerRuntime(svc, "doc", channels=(SharedString("text"),))
+    assert c.client_id == old_slot, "test needs the slot to recycle"
+    c.get_channel("text").insert_text(4, "QQ")
+    c.flush()
+
+    a.reconnect()
+    drain([a, b, c])
+    texts = {
+        rt.get_channel("text").get_text() for rt in (a, b, c)
+    }
+    assert len(texts) == 1, f"divergence: {texts}"
+    # Exact content: C's insert lands in "base" untouched by recycling (a
+    # recycled slot must not overwrite the old holder's payloads), and A's
+    # resubmitted pending insert rebases to the front.
+    assert texts.pop() == "PPbaseQQ"
