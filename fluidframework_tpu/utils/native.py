@@ -19,16 +19,15 @@ _NATIVE_DIR = os.path.join(
     "native",
 )
 
-_castore_lib = None
-_castore_tried = False
+_libs: dict = {}  # so name -> CDLL | None (None = tried and failed)
 
 
-def _load_castore() -> Optional[ctypes.CDLL]:
-    global _castore_lib, _castore_tried
-    if _castore_tried:
-        return _castore_lib
-    _castore_tried = True
-    so = os.path.join(_NATIVE_DIR, "libcastore.so")
+def _load_lib(so_name: str) -> Optional[ctypes.CDLL]:
+    """Load (building on demand with make) one native library; cached."""
+    if so_name in _libs:
+        return _libs[so_name]
+    _libs[so_name] = None
+    so = os.path.join(_NATIVE_DIR, so_name)
     if not os.path.exists(so):
         try:
             subprocess.run(
@@ -40,9 +39,21 @@ def _load_castore() -> Optional[ctypes.CDLL]:
         except (subprocess.SubprocessError, OSError):
             return None
     try:
-        lib = ctypes.CDLL(so)
+        _libs[so_name] = ctypes.CDLL(so)
     except OSError:
         return None
+    return _libs[so_name]
+
+
+_castore_registered = False
+
+
+def _load_castore() -> Optional[ctypes.CDLL]:
+    global _castore_registered
+    lib = _load_lib("libcastore.so")
+    if lib is None or _castore_registered:
+        return lib
+    _castore_registered = True
     lib.castore_new.restype = ctypes.c_void_p
     lib.castore_new.argtypes = [ctypes.c_char_p]
     lib.castore_free.argtypes = [ctypes.c_void_p]
@@ -63,7 +74,6 @@ def _load_castore() -> Optional[ctypes.CDLL]:
     ]
     lib.castore_has.restype = ctypes.c_int
     lib.castore_has.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-    _castore_lib = lib
     return lib
 
 
@@ -105,3 +115,86 @@ class NativeBlobStore:
 
 def native_store_available() -> bool:
     return _load_castore() is not None
+
+
+_coord_registered = False
+
+
+def _load_coord() -> Optional[ctypes.CDLL]:
+    global _coord_registered
+    lib = _load_lib("libcoord.so")
+    if lib is None or _coord_registered:
+        return lib
+    _coord_registered = True
+    lib.coord_new.restype = ctypes.c_void_p
+    lib.coord_new.argtypes = [ctypes.c_char_p]
+    lib.coord_free.argtypes = [ctypes.c_void_p]
+    lib.coord_acquire.restype = ctypes.c_int64
+    lib.coord_acquire.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int64, ctypes.c_int64,
+    ]
+    lib.coord_renew.restype = ctypes.c_int
+    lib.coord_renew.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int64, ctypes.c_int64,
+    ]
+    lib.coord_holder.restype = ctypes.c_int64
+    lib.coord_holder.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.coord_epoch.restype = ctypes.c_int64
+    lib.coord_epoch.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    return lib
+
+
+class NativeCoordination:
+    """C++ lease coordination (the ZooKeeper-client equivalent): fenced
+    epochs per document, caller-supplied clock (ms), optional append-log
+    durability. Same surface as the pure-Python ReservationManager."""
+
+    def __init__(self, clock, path: Optional[str] = None):
+        lib = _load_coord()
+        if lib is None:
+            raise RuntimeError("libcoord.so unavailable")
+        self._lib = lib
+        self._clock = clock
+        self._h = lib.coord_new(path.encode() if path else None)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.coord_free(self._h)
+            self._h = None
+
+    def _now_ms(self) -> int:
+        return int(self._clock() * 1000)
+
+    def acquire(self, node: str, doc_id: str, ttl_s: float) -> Optional[int]:
+        epoch = self._lib.coord_acquire(
+            self._h, node.encode(), doc_id.encode(),
+            int(ttl_s * 1000), self._now_ms(),
+        )
+        return int(epoch) if epoch > 0 else None
+
+    def renew(self, node: str, doc_id: str, ttl_s: float) -> bool:
+        return bool(
+            self._lib.coord_renew(
+                self._h, node.encode(), doc_id.encode(),
+                int(ttl_s * 1000), self._now_ms(),
+            )
+        )
+
+    def holder(self, doc_id: str) -> Optional[str]:
+        out = ctypes.create_string_buffer(256)
+        n = self._lib.coord_holder(
+            self._h, doc_id.encode(), self._now_ms(), out, 256
+        )
+        return out.raw[:n].decode() if n >= 0 else None
+
+    def epoch(self, doc_id: str) -> int:
+        return int(self._lib.coord_epoch(self._h, doc_id.encode()))
+
+
+def native_coordination_available() -> bool:
+    return _load_coord() is not None

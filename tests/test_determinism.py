@@ -1,0 +1,109 @@
+"""Determinism checker — the TPU build's race detector (SURVEY.md §5.2).
+
+The reference's single-threaded JS makes op application trivially
+deterministic; here the same sequenced stream may execute under different
+batch splits, doc-block shapes, executors (XLA vs Pallas vs oracle), and
+compaction schedules. The invariant: **any** such execution of the same
+per-document op stream yields bit-identical segment state. This is what
+makes cross-replica convergence independent of scheduling.
+"""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.ops.merge_kernel import batched_apply_ops, batched_compact
+from fluidframework_tpu.ops.pallas_compact import pallas_batched_compact
+from fluidframework_tpu.ops.pallas_kernel import pallas_batched_apply_ops
+from fluidframework_tpu.ops.segment_state import SegmentState, make_batched_state
+from fluidframework_tpu.protocol.constants import NO_CLIENT
+from fluidframework_tpu.testing.oracle import OracleDoc
+
+from test_pallas_kernel import assert_states_equal, random_acked_stream
+
+
+def _stream(seed, n_ops=48):
+    rng = np.random.default_rng(seed)
+    payloads = {}
+    return np.stack(
+        random_acked_stream(rng, n_ops, payloads, OracleDoc(NO_CLIENT))
+    ).astype(np.int32)
+
+
+def _copy(s):
+    import jax.numpy as jnp
+
+    return SegmentState(*[jnp.asarray(np.asarray(x)) for x in s])
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_batch_split_invariance(seed):
+    """Applying the stream in one batch vs many smaller batches is
+    bit-identical (batch boundaries are scheduling, not semantics)."""
+    ops = _stream(seed)
+    n = ops.shape[0]
+    batch = np.broadcast_to(ops, (4,) + ops.shape).copy()
+
+    whole = batched_apply_ops(make_batched_state(4, 128, NO_CLIENT), batch)
+    for splits in ([n // 3, 2 * n // 3], [1] * 0 + [n // 2], list(range(4, n, 7))):
+        state = make_batched_state(4, 128, NO_CLIENT)
+        prev = 0
+        for cut in splits + [n]:
+            if cut > prev:
+                state = batched_apply_ops(state, batch[:, prev:cut])
+                prev = cut
+        assert_states_equal(whole, state)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_block_shape_invariance(seed):
+    """Pallas grid block size is scheduling: any block_docs gives the same
+    bits (the multi-chip shard layout changes nothing either — sharding
+    splits the same doc axis)."""
+    ops = _stream(seed)
+    batch = np.broadcast_to(ops, (8,) + ops.shape).copy()
+    ref = None
+    for blk in (1, 2, 4, 8):
+        st = pallas_batched_apply_ops(
+            make_batched_state(8, 128, NO_CLIENT), batch, block_docs=blk
+        )
+        if ref is None:
+            ref = st
+        else:
+            assert_states_equal(ref, st)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_compaction_schedule_invariance(seed):
+    """Compaction timing is replica-local: interleaving compactions at any
+    batch boundary must not change the *observable* state (the compacted
+    form of both executions is identical)."""
+    ops = _stream(seed)
+    n = ops.shape[0]
+    batch = np.broadcast_to(ops, (2,) + ops.shape).copy()
+
+    a = batched_apply_ops(make_batched_state(2, 128, NO_CLIENT), batch)
+    a = batched_compact(a)
+
+    b = make_batched_state(2, 128, NO_CLIENT)
+    b = batched_apply_ops(b, batch[:, : n // 2])
+    b = batched_compact(b)
+    b = batched_apply_ops(b, batch[:, n // 2 :])
+    b = batched_compact(b)
+    # Compare post-compaction canonical forms.
+    assert_states_equal(batched_compact(_copy(a)), batched_compact(_copy(b)))
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_executor_invariance(seed):
+    """XLA kernel, Pallas kernel, and both compactors agree bit-for-bit —
+    replicas may mix executors (CPU client, TPU service) freely."""
+    ops = _stream(seed)
+    batch = np.broadcast_to(ops, (4,) + ops.shape).copy()
+    x = batched_apply_ops(make_batched_state(4, 128, NO_CLIENT), batch)
+    p = pallas_batched_apply_ops(
+        make_batched_state(4, 128, NO_CLIENT), batch, block_docs=2
+    )
+    assert_states_equal(x, p)
+    assert_states_equal(
+        batched_compact(_copy(x)), pallas_batched_compact(_copy(p))
+    )
