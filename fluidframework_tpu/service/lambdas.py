@@ -294,17 +294,15 @@ class ScribeDocLambda(PartitionLambda):
         if msg.sequence_number in self._decided:
             return []  # replay after crash: decision already produced
         self._decided.add(msg.sequence_number)
-        handle = msg.contents["handle"]
-        head = msg.contents["head"]
+        from fluidframework_tpu.service.summary_store import scribe_decide
+
         m = Lumberjack.new_metric(
             LumberEventName.SummaryWrite,
             {"tenantId": "local", "documentId": self.doc_id,
              "summarySequenceNumber": msg.sequence_number},
         )
-        ok = (
-            msg.reference_sequence_number >= self.protocol_head
-            and self.store.has(handle)
-        )
+        ok, contents = scribe_decide(msg, self.protocol_head, self.store)
+        handle, head = contents["handle"], contents["head"]
         if ok:
             self.latest_summary = (handle, head)
             self.protocol_head = msg.sequence_number

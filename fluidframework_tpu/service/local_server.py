@@ -179,25 +179,17 @@ class LocalFluidService:
             self._scribe(doc, res)
 
     def _scribe(self, doc: _DocState, msg: SequencedDocumentMessage) -> None:
-        """Validate a sequenced Summarize op and ack/nack it (reference
-        scribe/lambda.ts:204-240: refSeq must not precede the protocol head
-        and the uploaded tree must exist)."""
-        handle = msg.contents["handle"]
-        head = msg.contents["head"]
-        ok = (
-            msg.reference_sequence_number >= doc.protocol_head
-            and self.store.has(handle)
-        )
+        """Validate a sequenced Summarize op and ack/nack it (the shared
+        scribe rule, summary_store.scribe_decide)."""
+        from fluidframework_tpu.service.summary_store import scribe_decide
+
+        ok, contents = scribe_decide(msg, doc.protocol_head, self.store)
         if ok:
-            doc.latest_summary = (handle, head)
+            doc.latest_summary = (contents["handle"], contents["head"])
             doc.protocol_head = msg.sequence_number
         ack = doc.sequencer._sequence_system(
             MessageType.SUMMARY_ACK if ok else MessageType.SUMMARY_NACK,
-            contents={
-                "handle": handle,
-                "summary_seq": msg.sequence_number,
-                "head": head,
-            },
+            contents=contents,
         )
         self._broadcast(doc, ack)
 

@@ -273,8 +273,12 @@ class NodeCluster:
             node = next((n for n in self.nodes if n.name == holder), None)
             if node is not None and node.alive and node.try_own(doc_id):
                 return node
-        # Assign: spread by hash, skipping dead nodes.
-        start = hash(doc_id) % len(self.nodes)
+        # Assign: spread by a STABLE hash (builtin hash is seed-randomized
+        # per process, which would make placement nondeterministic), skipping
+        # dead nodes.
+        import zlib
+
+        start = zlib.crc32(doc_id.encode()) % len(self.nodes)
         for i in range(len(self.nodes)):
             node = self.nodes[(start + i) % len(self.nodes)]
             if node.alive and node.try_own(doc_id):
@@ -406,24 +410,18 @@ class MultiNodeFluidService:
 
     def _scribe(self, doc_id: str, node: OrderingNode,
                 msg: SequencedDocumentMessage) -> None:
+        from fluidframework_tpu.service.summary_store import scribe_decide
+
         st = self._scribe_state.setdefault(
             doc_id, {"protocol_head": 0, "latest": None}
         )
-        handle = msg.contents["handle"]
-        head = msg.contents["head"]
-        ok = (
-            msg.reference_sequence_number >= st["protocol_head"]
-            and self.store.has(handle)
-        )
+        ok, contents = scribe_decide(msg, st["protocol_head"], self.store)
         if ok:
-            st["latest"] = (handle, head)
+            st["latest"] = (contents["handle"], contents["head"])
             st["protocol_head"] = msg.sequence_number
         ack = node._docs[doc_id]._sequence_system(
             MessageType.SUMMARY_ACK if ok else MessageType.SUMMARY_NACK,
-            contents={
-                "handle": handle, "summary_seq": msg.sequence_number,
-                "head": head,
-            },
+            contents=contents,
         )
         node._emit(doc_id, ack)
 

@@ -31,6 +31,24 @@ class _DictBackend:
         return handle in self._blobs
 
 
+def scribe_decide(msg, protocol_head: int, store: "SummaryStore"):
+    """The scribe acceptance rule for a sequenced Summarize op (reference
+    scribe/lambda.ts:204-240): the op's refSeq must not precede the protocol
+    head and the uploaded tree must exist. Returns (ok, ack_contents) —
+    shared by every service variant so the rule can't diverge."""
+    handle = msg.contents["handle"]
+    head = msg.contents["head"]
+    ok = (
+        msg.reference_sequence_number >= protocol_head
+        and store.has(handle)
+    )
+    return ok, {
+        "handle": handle,
+        "summary_seq": msg.sequence_number,
+        "head": head,
+    }
+
+
 class SummaryStore:
     """Content-addressed store over a pluggable blob backend: the native
     C++ store (``native/ca_store.cpp``, optionally disk-persistent) when

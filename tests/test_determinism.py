@@ -44,7 +44,7 @@ def test_batch_split_invariance(seed):
     batch = np.broadcast_to(ops, (4,) + ops.shape).copy()
 
     whole = batched_apply_ops(make_batched_state(4, 128, NO_CLIENT), batch)
-    for splits in ([n // 3, 2 * n // 3], [1] * 0 + [n // 2], list(range(4, n, 7))):
+    for splits in ([n // 3, 2 * n // 3], [1, n // 2], list(range(4, n, 7))):
         state = make_batched_state(4, 128, NO_CLIENT)
         prev = 0
         for cut in splits + [n]:
