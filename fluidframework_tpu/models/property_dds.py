@@ -1,0 +1,229 @@
+"""SharedPropertyTree — typed property-tree DDS with changeset algebra.
+
+Reference: ``experimental/PropertyDDS`` (Autodesk) — a typed property tree
+(`property-properties`) whose edits are **changesets** with a full algebra
+(`property-changeset`): apply, squash (compose), and rebase. Properties are
+typed primitives (Int32/Float64/String/Bool) or containers (NodeProperty
+maps); paths address nested properties.
+
+This build's subset keeps the shape of that algebra:
+
+- ``Changeset`` = {insert: {path: (typeid, value)}, modify: {path: value},
+  remove: [path]} with ``squash`` composing two changesets and ``rebase``
+  transforming one over a concurrent one (modify/modify resolves by the
+  sequenced order — the later writer wins; edits inside a removed subtree
+  drop).
+- Local edits accumulate in a pending changeset; ``commit()`` ships it as
+  one op (the PropertyDDS commit model), remote changesets rebase pending.
+- Typed set enforces the property's declared typeid.
+
+Array/positional OT of the reference's ArrayProperty is intentionally out
+of scope for round 1 (the sequence DDSes cover positional merge).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Tuple
+
+from fluidframework_tpu.protocol.types import SequencedDocumentMessage
+from fluidframework_tpu.runtime.shared_object import SharedObject
+
+_PRIMS = {"Int32", "Float64", "String", "Bool"}
+
+
+def _check_type(typeid: str, value: Any) -> None:
+    ok = {
+        "Int32": lambda v: isinstance(v, int) and not isinstance(v, bool),
+        "Float64": lambda v: isinstance(v, (int, float)) and not isinstance(v, bool),
+        "String": lambda v: isinstance(v, str),
+        "Bool": lambda v: isinstance(v, bool),
+        "NodeProperty": lambda v: v is None,
+    }.get(typeid)
+    if ok is None:
+        raise TypeError(f"unknown typeid {typeid!r}")
+    if not ok(value):
+        raise TypeError(f"{value!r} is not a {typeid}")
+
+
+def empty_changeset() -> dict:
+    return {"insert": {}, "modify": {}, "remove": []}
+
+
+def is_empty(cs: dict) -> bool:
+    return not (cs["insert"] or cs["modify"] or cs["remove"])
+
+
+def _under(prefix: str, path: str) -> bool:
+    return path == prefix or path.startswith(prefix + ".")
+
+
+def squash(first: dict, second: dict) -> dict:
+    """Compose: apply(doc, squash(a, b)) == apply(apply(doc, a), b)."""
+    out = copy.deepcopy(first)
+    for path in second["remove"]:
+        # The remove cancels only when the removed path ITSELF was created
+        # by the first changeset (insert+remove = net nothing). Descendant
+        # inserts under a pre-existing path clean out, but the remove still
+        # ships — the pre-existing property must go on every replica.
+        created_here = path in out["insert"]
+        out["insert"] = {
+            p: v for p, v in out["insert"].items() if not _under(path, p)
+        }
+        out["modify"] = {
+            p: v for p, v in out["modify"].items() if not _under(path, p)
+        }
+        if path not in out["remove"] and not created_here:
+            out["remove"].append(path)
+    for path, tv in second["insert"].items():
+        out["insert"][path] = copy.deepcopy(tv)
+        if path in out["remove"]:
+            out["remove"].remove(path)
+    for path, v in second["modify"].items():
+        if path in out["insert"]:
+            out["insert"][path] = (out["insert"][path][0], copy.deepcopy(v))
+        else:
+            out["modify"][path] = copy.deepcopy(v)
+    return out
+
+
+def rebase(cs: dict, over: dict) -> dict:
+    """Transform ``cs`` to apply after ``over`` (concurrent, sequenced
+    first): edits under subtrees ``over`` removed are dropped; conflicting
+    modifies keep ``cs`` (it sequences later, so it wins LWW)."""
+    out = empty_changeset()
+    removed = over["remove"]
+
+    def survives(path: str) -> bool:
+        return not any(_under(r, path) for r in removed)
+
+    for path, tv in cs["insert"].items():
+        if survives(path) or path in removed:
+            out["insert"][path] = copy.deepcopy(tv)
+    for path, v in cs["modify"].items():
+        if survives(path):
+            out["modify"][path] = copy.deepcopy(v)
+    for path in cs["remove"]:
+        if survives(path):
+            out["remove"].append(path)
+    return out
+
+
+def apply_changeset(props: dict, cs: dict) -> None:
+    """props: path -> (typeid, value) flat map (nested paths dotted)."""
+    for path in cs["remove"]:
+        for p in [p for p in props if _under(path, p)]:
+            del props[p]
+    for path, (typeid, value) in cs["insert"].items():
+        props[path] = (typeid, copy.deepcopy(value))
+    for path, value in cs["modify"].items():
+        if path in props:
+            props[path] = (props[path][0], copy.deepcopy(value))
+
+
+class SharedPropertyTree(SharedObject):
+    """PropertyDDS subset: typed properties, changeset commits."""
+
+    def __init__(self, channel_id: str):
+        super().__init__(channel_id)
+        self._props: Dict[str, Tuple[str, Any]] = {}
+        self._staged = empty_changeset()  # uncommitted local edits
+        self._pending: List[dict] = []  # committed, awaiting sequencing
+
+    # -- reads ----------------------------------------------------------------
+
+    def get(self, path: str, default: Any = None) -> Any:
+        view = dict(self._props)
+        for cs in self._pending + ([self._staged] if not is_empty(self._staged) else []):
+            apply_changeset(view, cs)
+        tv = view.get(path)
+        return tv[1] if tv is not None else default
+
+    def typeid_of(self, path: str) -> Optional[str]:
+        view = dict(self._props)
+        for cs in self._pending + [self._staged]:
+            apply_changeset(view, cs)
+        tv = view.get(path)
+        return tv[0] if tv is not None else None
+
+    def keys(self, prefix: str = "") -> List[str]:
+        view = dict(self._props)
+        for cs in self._pending + [self._staged]:
+            apply_changeset(view, cs)
+        return sorted(
+            p for p in view if not prefix or _under(prefix, p)
+        )
+
+    # -- edits (staged until commit, the PropertyDDS model) --------------------
+
+    def insert_property(self, path: str, typeid: str, value: Any = None) -> None:
+        _check_type(typeid, value)
+        self._staged = squash(
+            self._staged, {"insert": {path: (typeid, value)}, "modify": {},
+                           "remove": []}
+        )
+
+    def set_value(self, path: str, value: Any) -> None:
+        tid = self.typeid_of(path)
+        if tid is None:
+            raise KeyError(path)
+        _check_type(tid, value)
+        self._staged = squash(
+            self._staged, {"insert": {}, "modify": {path: value}, "remove": []}
+        )
+
+    def remove_property(self, path: str) -> None:
+        self._staged = squash(
+            self._staged, {"insert": {}, "modify": {}, "remove": [path]}
+        )
+
+    def commit(self) -> None:
+        """Ship the staged changeset as one sequenced op."""
+        if is_empty(self._staged):
+            return
+        cs, self._staged = self._staged, empty_changeset()
+        self._pending.append(cs)
+        self.submit_local_message({"cs": cs})
+
+    # -- sequenced stream ------------------------------------------------------
+
+    def process_core(
+        self,
+        msg: SequencedDocumentMessage,
+        local: bool,
+        local_metadata: Optional[Any],
+    ) -> None:
+        cs = msg.contents["cs"]
+        if local:
+            if self._pending:
+                self._pending.pop(0)
+            apply_changeset(self._props, cs)
+            return
+        apply_changeset(self._props, cs)
+        # Concurrent remote changeset: rebase our pending + staged over it.
+        self._pending = [rebase(p, cs) for p in self._pending]
+        self._staged = rebase(self._staged, cs)
+
+    def resubmit_core(self, contents: Any, local_metadata: Any) -> None:
+        if self._resubmit_i < len(self._pending):
+            cs = self._pending[self._resubmit_i]
+            self._resubmit_i += 1
+            self.submit_local_message({"cs": cs})
+
+    def begin_resubmit(self) -> None:
+        self._resubmit_i = 0
+
+    # -- summary ---------------------------------------------------------------
+
+    def summarize_core(self) -> dict:
+        assert not self._pending and is_empty(self._staged)
+        return {"props": {p: [t, v] for p, (t, v) in self._props.items()}}
+
+    def load_core(self, summary: dict) -> None:
+        self._props = {
+            p: (t, v) for p, (t, v) in (
+                (p, tuple(tv)) for p, tv in summary["props"].items()
+            )
+        }
+        self._pending = []
+        self._staged = empty_changeset()

@@ -86,6 +86,7 @@ class ContainerRuntime:
         self.pending_proposals: Dict[int, tuple] = {}
         self.approved_proposals: Dict[str, Any] = {}
         self.on_op: Optional[Callable[[SequencedDocumentMessage], None]] = None
+        self._op_listeners: list = []  # multi-subscriber op tap (helpers)
         # Summary tracking (reference SummaryCollection / RunningSummarizer).
         self.last_summary_seq = 0
         self.summary_interval: Optional[int] = None  # auto-summarize period
@@ -253,6 +254,12 @@ class ContainerRuntime:
         msgs = self.connection.take_inbox(n)
         for msg in msgs:
             self._process_one(msg)
+            # A channel may submit DURING processing (e.g. an OT channel
+            # releasing its next queued batch on ack). Send it before the
+            # NEXT inbound message is processed, or its wire refSeq would
+            # claim a context the op was never transformed against.
+            if self._outbox and self.connected:
+                self.flush()
         # Batch atomicity (reference ScheduleManager/DeltaScheduler): never
         # yield mid-batch — if the limit n landed inside a batch, keep
         # draining until its batchEnd arrives.
@@ -388,6 +395,21 @@ class ContainerRuntime:
         self._maybe_auto_summarize()
         if self.on_op is not None:
             self.on_op(msg)
+        for fn in list(self._op_listeners):
+            fn(msg)
+
+    def add_op_listener(
+        self, fn: Callable[[SequencedDocumentMessage], None]
+    ) -> Callable[[], None]:
+        """Subscribe to every processed sequenced message; returns the
+        unsubscribe handle (view adapters attach/detach through this)."""
+        self._op_listeners.append(fn)
+
+        def unsubscribe() -> None:
+            if fn in self._op_listeners:
+                self._op_listeners.remove(fn)
+
+        return unsubscribe
 
     # -- connection lifecycle (disconnect / reconnect + resubmit, §5.3) ------
 

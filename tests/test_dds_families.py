@@ -1,0 +1,354 @@
+"""The remaining DDS families: legacy tree, OT json, PropertyDDS, SparseMatrix
+(reference experimental/dds/* + PropertyDDS + sequence-deprecated)."""
+
+import pytest
+
+from fluidframework_tpu.models.ot_json import SharedOTJson, apply_op, transform
+from fluidframework_tpu.models.property_dds import (
+    SharedPropertyTree,
+    apply_changeset,
+    empty_changeset,
+    rebase,
+    squash,
+)
+from fluidframework_tpu.models.sparse_matrix import SparseMatrix
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service.local_server import LocalFluidService
+from fluidframework_tpu.tree.legacy_tree import LegacySharedTree
+
+
+def setup(channel_factory, n=2, doc="fam-doc"):
+    svc = LocalFluidService()
+    rts = [
+        ContainerRuntime(svc, doc, channels=(channel_factory(),))
+        for _ in range(n)
+    ]
+    return svc, rts
+
+
+def drain(rts):
+    for rt in rts:
+        rt.flush()
+    busy = True
+    while busy:
+        busy = any(rt.process_incoming() for rt in rts)
+
+
+# ---------------------------------------------------------------------------
+# Legacy SharedTree
+
+
+def test_legacy_tree_edits_history_and_undo():
+    svc, (a, b) = setup(lambda: LegacySharedTree("t"))
+    ta, tb = a.get_channel("t"), b.get_channel("t")
+    nid = ta.insert_node(0, "kids", {"type": "n", "value": "hello"})
+    drain([a, b])
+    assert tb.current_view() == ta.current_view()
+    assert len(tb.edit_log) == 1
+
+    e2 = ta.apply_edit({"k": "val", "id": nid, "value": "changed"})
+    drain([a, b])
+    assert tb.current_view()["fields"]["kids"][0]["value"] == "changed"
+
+    # History: revision views before/after; undo restores the old value.
+    view_before = tb.log_viewer.revision_at(1)
+    assert view_before.subtree(0)["fields"]["kids"][0]["value"] == "hello"
+    ta.undo(e2)
+    drain([a, b])
+    assert tb.current_view()["fields"]["kids"][0]["value"] == "hello"
+
+
+def test_legacy_tree_constraint_drops_whole_edit():
+    svc, (a, b) = setup(lambda: LegacySharedTree("t"))
+    ta, tb = a.get_channel("t"), b.get_channel("t")
+    nid = ta.insert_node(0, "kids", {"type": "n", "value": 1})
+    drain([a, b])
+    # a's edit requires kids to still have exactly 1 element; b concurrently
+    # inserts, sequencing first -> a's whole edit becomes a no-op everywhere.
+    tb.insert_node(0, "kids", {"type": "n", "value": 2})
+    b.flush()
+    ta.apply_edit(
+        {"k": "constraint", "parent": 0, "field": "kids", "length": 1},
+        {"k": "val", "id": nid, "value": 99},
+    )
+    drain([a, b])
+    assert ta.current_view() == tb.current_view()
+    vals = [k["value"] for k in ta.current_view()["fields"]["kids"]]
+    assert 99 not in vals, "constrained edit must drop atomically"
+
+
+# ---------------------------------------------------------------------------
+# OT json
+
+
+def test_ot_transform_list_indices():
+    op = {"p": [5], "li": "x"}
+    assert transform(op, {"p": [2], "li": "y"})["p"] == [6]
+    assert transform(op, {"p": [2], "ld": 1})["p"] == [4]
+    assert transform({"p": [2], "ld": 1}, {"p": [2], "ld": 1}) is None
+    # Delete of an ancestor kills nested edits.
+    assert transform({"p": ["a", "b"], "oi": 1}, {"p": ["a"], "od": 1}) is None
+
+
+def test_ot_json_concurrent_lists_converge():
+    svc, (a, b) = setup(lambda: SharedOTJson("j", initial={"items": []}))
+    ja, jb = a.get_channel("j"), b.get_channel("j")
+    ja.list_insert(["items"], 0, "from-a")
+    jb.list_insert(["items"], 0, "from-b")
+    drain([a, b])
+    assert ja.as_data() == jb.as_data()
+    assert set(ja.get("items")) == {"from-a", "from-b"}
+
+    ja.list_delete(["items"], 0)
+    jb.list_insert(["items"], 2, "tail")
+    drain([a, b])
+    assert ja.as_data() == jb.as_data()
+    assert len(ja.get("items")) == 2
+
+
+def test_ot_json_number_add_commutes():
+    svc, (a, b) = setup(lambda: SharedOTJson("j", initial={"n": 0}))
+    ja, jb = a.get_channel("j"), b.get_channel("j")
+    ja.number_add(["n"], 5)
+    jb.number_add(["n"], 7)
+    drain([a, b])
+    assert ja.get("n") == jb.get("n") == 12
+
+
+def test_ot_json_delete_vs_nested_edit():
+    svc, (a, b) = setup(
+        lambda: SharedOTJson("j", initial={"cfg": {"x": 1}})
+    )
+    ja, jb = a.get_channel("j"), b.get_channel("j")
+    ja.delete_key(["cfg"])
+    jb.set_key(["cfg", "x"], 99)  # concurrent edit inside deleted subtree
+    drain([a, b])
+    assert ja.as_data() == jb.as_data()
+    assert ja.get("cfg") is None
+
+
+# ---------------------------------------------------------------------------
+# PropertyDDS
+
+
+def test_property_changeset_algebra():
+    a = {"insert": {"p.x": ("Int32", 1)}, "modify": {}, "remove": []}
+    b = {"insert": {}, "modify": {"p.x": 2}, "remove": []}
+    sq = squash(a, b)
+    doc = {}
+    apply_changeset(doc, sq)
+    assert doc["p.x"] == ("Int32", 2)
+    # squash associativity on a sample.
+    c = {"insert": {}, "modify": {}, "remove": ["p.x"]}
+    d1, d2 = {}, {}
+    apply_changeset(d1, squash(squash(a, b), c))
+    apply_changeset(d2, squash(a, squash(b, c)))
+    assert d1 == d2
+    # rebase drops edits under a removed subtree.
+    r = rebase(b, c)
+    assert not r["modify"]
+
+
+def test_property_tree_commit_and_convergence():
+    svc, (a, b) = setup(lambda: SharedPropertyTree("p"))
+    pa, pb = a.get_channel("p"), b.get_channel("p")
+    pa.insert_property("car.speed", "Int32", 60)
+    pa.insert_property("car.name", "String", "zippy")
+    pa.commit()
+    drain([a, b])
+    assert pb.get("car.speed") == 60
+    with pytest.raises(TypeError):
+        pb.set_value("car.speed", "fast")  # typed set enforces Int32
+
+    # Concurrent: a modifies; b removes the subtree. Removal sequences
+    # first; a's rebase drops the modify.
+    pb.remove_property("car.speed")
+    pb.commit()
+    b.flush()
+    pa.set_value("car.speed", 80)
+    pa.commit()
+    drain([a, b])
+    assert pa.get("car.speed") == pb.get("car.speed") is None
+    assert pa.get("car.name") == "zippy"
+
+
+def test_property_tree_summary_roundtrip():
+    svc, (a,) = setup(lambda: SharedPropertyTree("p"), n=1)
+    pa = a.get_channel("p")
+    pa.insert_property("cfg.flag", "Bool", True)
+    pa.commit()
+    drain([a])
+    a.submit_summary()
+    drain([a])
+    late = ContainerRuntime(
+        svc, "fam-doc", channels=(SharedPropertyTree("p"),)
+    )
+    drain([a, late])
+    assert late.get_channel("p").get("cfg.flag") is True
+
+
+# ---------------------------------------------------------------------------
+# SparseMatrix
+
+
+def test_sparse_matrix_rows_and_cells_converge():
+    svc, (a, b) = setup(lambda: SparseMatrix("sm"))
+    ma, mb = a.get_channel("sm"), b.get_channel("sm")
+    ma.insert_rows(0, 3)
+    drain([a, b])
+    ma.set_cell(0, 0, "r0c0")
+    ma.set_cell(2, 8000, "r2-far")  # huge virtual column space
+    drain([a, b])
+    assert mb.get_cell(0, 0) == "r0c0"
+    assert mb.get_cell(2, 8000) == "r2-far"
+
+    # Concurrent row inserts at the same position converge.
+    ma.insert_rows(1, 1)
+    mb.insert_rows(1, 1)
+    drain([a, b])
+    assert ma.row_count == mb.row_count == 5
+    # Cells ride their row handles through reordering.
+    assert mb.get_cell(0, 0) == "r0c0"
+    assert [ma.row_values(r) for r in range(5)] == [
+        mb.row_values(r) for r in range(5)
+    ]
+
+
+def test_sparse_matrix_remove_rows_and_summary():
+    svc, (a,) = setup(lambda: SparseMatrix("sm"), n=1)
+    ma = a.get_channel("sm")
+    ma.insert_rows(0, 4)
+    drain([a])
+    for r in range(4):
+        ma.set_cell(r, 1, f"row{r}")
+    drain([a])
+    ma.remove_rows(1, 2)
+    drain([a])
+    assert ma.row_count == 2
+    assert ma.get_cell(0, 1) == "row0"
+    assert ma.get_cell(1, 1) == "row3"
+
+    a.submit_summary()
+    drain([a])
+    late = ContainerRuntime(svc, "fam-doc", channels=(SparseMatrix("sm"),))
+    drain([a, late])
+    ml = late.get_channel("sm")
+    assert ml.row_count == 2
+    assert ml.get_cell(1, 1) == "row3"
+
+
+def test_ot_bridges_over_already_acked_ops():
+    """Remote ops whose author had not seen our ALREADY-SEQUENCED ops must
+    transform over them (total-order bridging), not apply raw."""
+    svc, (a, b) = setup(
+        lambda: SharedOTJson("j", initial={"items": ["a", "b", "c", "d", "e"]})
+    )
+    ja, jb = a.get_channel("j"), b.get_channel("j")
+    ja.list_insert(["items"], 0, "X")
+    jb.list_insert(["items"], 5, "Y")
+    # a's op sequences (and acks at a) before b's arrives at a.
+    a.flush()
+    a.process_incoming()
+    drain([a, b])
+    assert ja.as_data() == jb.as_data()
+    assert ja.get("items") == ["X", "a", "b", "c", "d", "e", "Y"]
+
+
+def test_ot_progressive_transform_across_batches():
+    """Later pending batches transform against the PROGRESSIVELY transformed
+    remote (an annihilated remote op must not shift them)."""
+    svc, (a, b) = setup(
+        lambda: SharedOTJson("j", initial={"items": ["a", "b"]})
+    )
+    ja, jb = a.get_channel("j"), b.get_channel("j")
+    jb.list_delete(["items"], 0)
+    b.flush()
+    ja.list_delete(["items"], 0)  # same element: annihilates vs remote
+    ja.list_insert(["items"], 1, "x")  # second batch
+    drain([a, b])
+    assert ja.as_data() == jb.as_data()
+    assert ja.get("items") == ["b", "x"]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_ot_json_fuzz_convergence(seed):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    svc, rts = setup(
+        lambda: SharedOTJson("j", initial={"items": list("abcd"), "n": 0}),
+        n=3,
+    )
+    docs = [rt.get_channel("j") for rt in rts]
+    for step in range(100):
+        i = int(rng.integers(0, 3))
+        d = docs[i]
+        items = d.get("items")
+        roll = rng.random()
+        if roll < 0.45:
+            d.list_insert(["items"], int(rng.integers(0, len(items) + 1)),
+                          f"s{step}")
+        elif roll < 0.7 and items:
+            d.list_delete(["items"], int(rng.integers(0, len(items))))
+        elif roll < 0.85:
+            d.number_add(["n"], int(rng.integers(1, 5)))
+        else:
+            d.set_key([f"k{int(rng.integers(0, 4))}"], step)
+        if step % 3 == 0:
+            rts[i].flush()
+        if step % 5 == 0:
+            for rt in rts:
+                rt.process_incoming()
+    drain(rts)
+    datas = [d.as_data() for d in docs]
+    assert datas[0] == datas[1] == datas[2]
+
+
+def test_property_remove_preexisting_with_staged_child_insert():
+    """remove_property on a pre-existing path must survive squash even when
+    the same staged changeset inserted a child under it."""
+    svc, (a, b) = setup(lambda: SharedPropertyTree("p"))
+    pa, pb = a.get_channel("p"), b.get_channel("p")
+    pa.insert_property("a", "Int32", 1)
+    pa.commit()
+    drain([a, b])
+    pa.insert_property("a.b", "Int32", 2)
+    pa.remove_property("a")
+    pa.commit()
+    drain([a, b])
+    assert pb.get("a") is None and pa.get("a") is None
+    assert pb.get("a.b") is None
+
+
+def test_legacy_tree_edit_references_its_own_insert():
+    """Changes inside one edit see their predecessors (insert then set)."""
+    svc, (a, b) = setup(lambda: LegacySharedTree("t"))
+    ta, tb = a.get_channel("t"), b.get_channel("t")
+    node = ta._assign_ids({"type": "n"})
+    ta.apply_edit(
+        {"k": "ins", "parent": 0, "field": "kids", "anchor": None,
+         "nodes": [node]},
+        {"k": "val", "id": node["id"], "value": "set-in-same-edit"},
+    )
+    drain([a, b])
+    assert (
+        tb.current_view()["fields"]["kids"][0]["value"]
+        == "set-in-same-edit"
+    )
+
+
+def test_view_adapter_detaches():
+    from fluidframework_tpu.framework.helpers import ViewAdapter
+    from fluidframework_tpu.models.shared_string import SharedString
+
+    svc, (a, b) = setup(lambda: SharedString("text"))
+    views = []
+    adapter = ViewAdapter(b, "text", lambda s: s.get_text())
+    adapter.subscribe(views.append)
+    a.get_channel("text").insert_text(0, "x")
+    drain([a, b])
+    n = len(views)
+    adapter.detach()
+    a.get_channel("text").insert_text(0, "y")
+    drain([a, b])
+    assert len(views) == n, "detached adapter must stop rendering"

@@ -1,0 +1,101 @@
+"""Framework helper packages (request-handler, oldest-client-observer,
+view-adapters, web-code-loader, location-redirection-utils)."""
+
+import pytest
+
+from fluidframework_tpu.drivers.local_driver import resolve_url
+from fluidframework_tpu.framework.helpers import (
+    LocationRedirectionResolver,
+    OldestClientObserver,
+    ViewAdapter,
+    WebCodeLoader,
+    build_runtime_request_handler,
+    channel_request_handler,
+)
+from fluidframework_tpu.models.shared_map import SharedMap
+from fluidframework_tpu.models.shared_string import SharedString
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service.local_server import LocalFluidService
+
+
+def drain(rts):
+    for rt in rts:
+        rt.flush()
+    busy = True
+    while busy:
+        busy = any(rt.process_incoming() for rt in rts)
+
+
+def test_request_handler_routes():
+    svc = LocalFluidService()
+    rt = ContainerRuntime(svc, "d", channels=(SharedString("text"),))
+    seen = []
+
+    def custom(parts, runtime):
+        if parts[:1] == ["_custom"]:
+            seen.append(parts)
+            return {"custom": parts[1:]}
+        return None
+
+    handle = build_runtime_request_handler(custom, channel_request_handler)
+    assert handle("/text", rt) is rt.get_channel("text")
+    assert handle("/_custom/a/b", rt) == {"custom": ["a", "b"]}
+    with pytest.raises(KeyError):
+        handle("/missing", rt)
+
+
+def test_oldest_client_observer_tracks_quorum():
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "d", channels=(SharedMap("m"),))
+    b = ContainerRuntime(svc, "d", channels=(SharedMap("m"),))
+    drain([a, b])
+    oa, ob = OldestClientObserver(a), OldestClientObserver(b)
+    assert oa.is_oldest and not ob.is_oldest
+
+    events = []
+    ob.on_change(lambda now: events.append(now))
+    a.disconnect()
+    drain([b])
+    assert ob.is_oldest
+    assert events == [True]
+
+
+def test_view_adapter_rerenders_on_ops():
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "d", channels=(SharedString("text"),))
+    b = ContainerRuntime(svc, "d", channels=(SharedString("text"),))
+    views = []
+    adapter = ViewAdapter(b, "text", lambda s: s.get_text())
+    adapter.subscribe(views.append)
+    a.get_channel("text").insert_text(0, "hi")
+    drain([a, b])
+    assert views[0] == "" and views[-1] == "hi"
+
+
+def test_web_code_loader_resolves_quorum_proposal():
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "d", channels=(SharedMap("m"),))
+    b = ContainerRuntime(svc, "d", channels=(SharedMap("m"),))
+    drain([a, b])
+    loader = WebCodeLoader()
+    loader.register("my-app@1.0", {"factory": "v1"})
+    with pytest.raises(KeyError):
+        loader.resolve(a)
+    loader.propose_code(a, "my-app@1.0")
+    drain([a, b])
+    # MSN must reach the proposal; a noop round-trip advances it.
+    a.send_noop()
+    b.send_noop()
+    drain([a, b])
+    assert loader.resolve(b) == {"factory": "v1"}
+
+
+def test_location_redirection_follows_moves():
+    r = LocationRedirectionResolver(resolve_url)
+    r.add_redirect("fluid-test://old/doc1", "fluid-test://new/doc1-moved")
+    assert r.resolve("fluid-test://old/doc1") == "doc1-moved"
+    assert r.resolve("fluid-test://host/plain") == "plain"
+    r.add_redirect("fluid-test://a/x", "fluid-test://b/x")
+    r.add_redirect("fluid-test://b/x", "fluid-test://a/x")
+    with pytest.raises(RuntimeError):
+        r.resolve("fluid-test://a/x")
