@@ -1,0 +1,299 @@
+"""BASELINE.md measurement configs 1-5 (BASELINE.json `configs`).
+
+``bench.py`` is the driver's headline line (config 2: batched merge-op
+apply). This harness runs the rest; each config prints one JSON line.
+
+    python bench_configs.py           # all configs, CI-sized
+    python bench_configs.py --full    # BASELINE-sized (TPU for 2/4/5)
+    python bench_configs.py --config 3
+
+Configs (BASELINE.md "Measurement configs to implement"):
+1. Single SharedString doc: insert/remove ops replayed through the replay
+   driver (CPU baseline; ref harness packages/drivers/replay-driver).
+2. Batched merge-op apply across concurrent docs (delegates to bench.py).
+3. SharedTree changeset rebase: docs x concurrent edits through the
+   EditManager trunk (ref editManager.ts:142-281).
+4. SharedMatrix axis merge across docs: permutation-vector op batches on
+   the Pallas kernel (ref permutationvector.ts:151).
+5. Deli+scribe end-to-end: many docs sequenced through the partitioned
+   lambda pipeline, sequenced batches applied device-side (ref
+   deli/lambda.ts:742) — the TpuDeliLambda shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _emit(**kv) -> None:
+    print(json.dumps(kv))
+
+
+# ---------------------------------------------------------------------------
+
+
+def config1_single_doc_replay(n_ops: int) -> None:
+    """CPU baseline: one doc's op log replayed through the replay driver."""
+    from fluidframework_tpu.drivers.replay_driver import ReplayDocumentService
+    from fluidframework_tpu.models.shared_string import SharedString
+    from fluidframework_tpu.runtime.container import ContainerRuntime
+    from fluidframework_tpu.service.local_server import LocalFluidService
+
+    rng = np.random.default_rng(0)
+    svc = LocalFluidService()
+    author = ContainerRuntime(svc, "doc", channels=(SharedString("text"),))
+    s = author.get_channel("text")
+    for i in range(n_ops):
+        length = len(s.get_text())
+        if length > 8 and rng.random() < 0.45:
+            a = int(rng.integers(0, length - 2))
+            s.remove_range(a, a + int(rng.integers(1, 3)))
+        else:
+            s.insert_text(int(rng.integers(0, length + 1)), "ab")
+        if i % 16 == 0:
+            author.flush()
+            author.process_incoming()
+    author.flush()
+    author.process_incoming()
+
+    replay = ReplayDocumentService(svc.get_deltas("doc"), doc_id="doc")
+    t0 = time.perf_counter()
+    reader = ContainerRuntime(replay, "doc", channels=(SharedString("text"),))
+    reader.process_incoming()
+    dt = time.perf_counter() - t0
+    assert reader.get_channel("text").get_text() == s.get_text()
+    total = len(svc.get_deltas("doc"))
+    _emit(
+        metric="single_doc_replay_ops_per_sec", value=round(total / dt),
+        unit="ops/s", config=1, n_ops=total,
+    )
+
+
+def config3_tree_rebase(n_docs: int, n_edits: int) -> None:
+    """Concurrent-edit rebase through the EditManager trunk: real
+    SharedTree clients editing without seeing each other until the flush,
+    so every sequenced commit transports through the rebase path."""
+    from fluidframework_tpu.runtime.container import ContainerRuntime
+    from fluidframework_tpu.service.local_server import LocalFluidService
+    from fluidframework_tpu.tree.shared_tree import SharedTree
+
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+    total = 0
+    for d in range(n_docs):
+        svc = LocalFluidService()
+        rts = [
+            ContainerRuntime(svc, "t", channels=(SharedTree("tree"),))
+            for _ in range(3)
+        ]
+        trees = [rt.get_channel("tree") for rt in rts]
+        for i in range(n_edits):
+            k = int(rng.integers(0, 3))
+            t = trees[k]
+            if len(t) > 2 and rng.random() < 0.3:
+                t.delete_nodes(int(rng.integers(0, len(t) - 1)), 1)
+            else:
+                t.insert_nodes(int(rng.integers(0, len(t) + 1)), [i])
+            total += 1
+            if i % 4 == 0:  # concurrency window: flush every few edits
+                rts[k].flush()
+            if i % 8 == 0:
+                for rt in rts:
+                    rt.process_incoming()
+        for rt in rts:
+            rt.flush()
+        busy = True
+        while busy:
+            busy = any(rt.process_incoming() for rt in rts)
+        assert trees[0].get() == trees[1].get() == trees[2].get()
+    dt = time.perf_counter() - t0
+    _emit(
+        metric="tree_rebase_edits_per_sec", value=round(total / dt),
+        unit="edits/s", config=3, n_docs=n_docs, edits_per_doc=n_edits,
+    )
+
+
+def config4_matrix_axis_merge(n_docs: int, k: int, on_tpu: bool) -> None:
+    """Row/col insert + annotate batches on the Pallas kernel: each doc is
+    two permutation vectors, so the batch is 2*n_docs kernel docs."""
+    import jax
+
+    from fluidframework_tpu.ops.pallas_compact import compact_packed
+    from fluidframework_tpu.ops.pallas_kernel import (
+        SC_ERR,
+        apply_ops_packed,
+        pack_state,
+    )
+    from fluidframework_tpu.ops.segment_state import make_batched_state
+    from fluidframework_tpu.protocol.constants import NO_CLIENT, OP_WIDTH
+    from fluidframework_tpu.ops import encode as E
+
+    rng = np.random.default_rng(0)
+    docs = 2 * n_docs  # row + col vector per matrix
+    ops = np.zeros((docs, k, OP_WIDTH), np.int32)
+    for d in range(min(docs, 16)):
+        length = 0
+        for i in range(k - 1):
+            seq = i + 1
+            roll = rng.random()
+            if length > 6 and roll < 0.3:
+                a = int(rng.integers(0, length - 2))
+                ops[d, i] = E.remove(a, a + 2, seq=seq, ref=seq - 1,
+                                     client=int(rng.integers(0, 8)))
+                length -= 2
+            elif length > 4 and roll < 0.5:
+                a = int(rng.integers(0, length - 2))
+                ops[d, i] = E.annotate(a, a + 2, 1 + i % 7, seq=seq,
+                                       ref=seq - 1,
+                                       client=int(rng.integers(0, 8)))
+            else:
+                ops[d, i] = E.insert(int(rng.integers(0, length + 1)),
+                                     100 + i, 4, seq=seq, ref=seq - 1,
+                                     client=int(rng.integers(0, 8)))
+                length += 4
+        # Close the script with a whole-doc remove + window advance so
+        # compaction reclaims the table each round (steady state; same
+        # pattern as bench.py's stream).
+        ops[d, k - 1] = E.remove(0, length, seq=k, ref=k - 1, client=0, msn=k)
+    for d in range(16, docs):
+        ops[d] = ops[d % 16]
+    jops = jax.device_put(ops)
+    tables, scalars = pack_state(make_batched_state(docs, 256, NO_CLIENT))
+    blk = 32 if on_tpu else 8
+    tables, scalars = apply_ops_packed(
+        tables, scalars, jops, block_docs=blk, interpret=not on_tpu
+    )
+    np.asarray(scalars[:, SC_ERR])
+    t0 = time.perf_counter()
+    iters = 3
+    for _ in range(iters):
+        tables, scalars = apply_ops_packed(
+            tables, scalars, jops, block_docs=blk, interpret=not on_tpu
+        )
+        tables, scalars = compact_packed(
+            tables, scalars, interpret=not on_tpu
+        )
+        errs = int(np.asarray(scalars[:, SC_ERR]).sum())
+    dt = time.perf_counter() - t0
+    _emit(
+        metric="matrix_axis_ops_per_sec", value=round(docs * k * iters / dt),
+        unit="ops/s", config=4, n_matrices=n_docs, errs=errs,
+    )
+
+
+def config5_deli_scribe_e2e(n_docs: int, ops_per_doc: int, on_tpu: bool) -> None:
+    """Host sequencing through deli (partitioned pipeline semantics) with
+    the sequenced batches applied as device kernel ops — the end-to-end
+    service shape (TpuDeliLambda)."""
+    import jax
+
+    from fluidframework_tpu.ops.pallas_compact import compact_packed
+    from fluidframework_tpu.ops.pallas_kernel import (
+        SC_ERR,
+        apply_ops_packed,
+        pack_state,
+    )
+    from fluidframework_tpu.ops import encode as E
+    from fluidframework_tpu.ops.segment_state import make_batched_state
+    from fluidframework_tpu.protocol.constants import NO_CLIENT, OP_WIDTH
+    from fluidframework_tpu.protocol.types import DocumentMessage, MessageType
+    from fluidframework_tpu.service.sequencer import DocumentSequencer
+
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+    # Host stage: per-doc deli ticket loops (16 distinct scripts, tiled).
+    batches = np.zeros((n_docs, ops_per_doc, OP_WIDTH), np.int32)
+    scripts = min(n_docs, 16)
+    for d in range(scripts):
+        seqr = DocumentSequencer(f"doc{d}")
+        join = seqr.join()
+        client = join.contents["clientId"]
+        length = 0
+        for i in range(ops_per_doc):
+            msg = seqr.ticket(
+                client,
+                DocumentMessage(
+                    client_sequence_number=i + 1,
+                    reference_sequence_number=seqr.seq,
+                    type=MessageType.OPERATION,
+                    contents=None,
+                ),
+            )
+            s = msg.sequence_number
+            if length >= 6 and rng.random() < 0.4:
+                a = int(rng.integers(0, length - 2))
+                batches[d, i] = E.remove(
+                    a, a + 2, seq=s, ref=s - 1, client=client,
+                    msn=msg.minimum_sequence_number,
+                )
+                length -= 2
+            else:
+                batches[d, i] = E.insert(
+                    int(rng.integers(0, length + 1)), 10 + i, 3,
+                    seq=s, ref=s - 1, client=client,
+                    msn=msg.minimum_sequence_number,
+                )
+                length += 3
+    for d in range(scripts, n_docs):
+        batches[d] = batches[d % scripts]
+    t_host = time.perf_counter() - t0
+
+    # Device stage: one apply+compact step over the whole fleet.
+    jops = jax.device_put(batches)
+    tables, scalars = pack_state(make_batched_state(n_docs, 128, NO_CLIENT))
+    blk = 32 if on_tpu else 8
+    tables, scalars = apply_ops_packed(
+        tables, scalars, jops, block_docs=blk, interpret=not on_tpu
+    )
+    tables, scalars = compact_packed(tables, scalars, interpret=not on_tpu)
+    errs = int(np.asarray(scalars[:, SC_ERR]).sum())
+    dt = time.perf_counter() - t0
+    total = n_docs * ops_per_doc
+    _emit(
+        metric="deli_to_device_e2e_ops_per_sec", value=round(total / dt),
+        unit="ops/s", config=5, n_docs=n_docs, host_stage_s=round(t_host, 3),
+        errs=errs,
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=0, help="0 = all")
+    ap.add_argument("--full", action="store_true",
+                    help="BASELINE-sized runs (needs the TPU for 4/5)")
+    args = ap.parse_args()
+
+    from fluidframework_tpu.ops.pallas_kernel import _on_tpu
+
+    on_tpu = _on_tpu()
+    full = args.full
+
+    if args.config in (0, 1):
+        config1_single_doc_replay(10_000 if full else 1_000)
+    if args.config in (0, 2):
+        import bench
+
+        bench.main()
+    if args.config in (0, 3):
+        config3_tree_rebase(
+            n_docs=1000 if full else 20, n_edits=1000 if full else 60
+        )
+    if args.config in (0, 4):
+        config4_matrix_axis_merge(
+            n_docs=10_000 if full else 16, k=64 if full else 16,
+            on_tpu=on_tpu,
+        )
+    if args.config in (0, 5):
+        config5_deli_scribe_e2e(
+            n_docs=100_000 if full else 64,
+            ops_per_doc=16 if full else 8,
+            on_tpu=on_tpu,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -101,11 +101,16 @@ class ViewAdapter:
         self._snapshot_fn = snapshot_fn
         self._subs: List[Callable[[Any], None]] = []
 
-        def on_op(_msg):
-            if self._subs:
-                view = self.render()
-                for fn in list(self._subs):
-                    fn(view)
+        def on_op(msg):
+            # Only ops addressed to the adapted channel change its view.
+            if not self._subs:
+                return
+            contents = msg.contents if isinstance(msg.contents, dict) else {}
+            if contents.get("address") != self._channel_id:
+                return
+            view = self.render()
+            for fn in list(self._subs):
+                fn(view)
 
         # Detachable: discarded adapters must not keep re-rendering forever.
         self.detach = runtime.add_op_listener(on_op)

@@ -77,25 +77,13 @@ class SharedMatrix(SharedObject):
         self._mint = 0  # per-connection axis-run id counter
 
     def on_reconnect(self, new_client_id: int) -> None:
-        """Adopt the new client slot on both axis kernels and restamp
-        pending rows (see SharedString.on_reconnect: rows that exist only
-        on this replica must not match a recycled slot's next holder)."""
-        import jax.numpy as jnp
+        """Adopt the new client slot on both axis kernels (see
+        ``segment_state.adopt_client_slot`` for the restamp rationale)."""
+        from fluidframework_tpu.ops.segment_state import adopt_client_slot
 
         self._mint = 0
         for vec in (self._rows, self._cols):
-            st = vec.state
-            pending_ins = st.seq == UNASSIGNED_SEQ
-            old_bit = jnp.int32(1) << jnp.clip(st.self_client, 0, 31)
-            new_bit = jnp.int32(1) << jnp.clip(jnp.int32(new_client_id), 0, 31)
-            pending_rem = st.rlseq > 0
-            vec.state = st._replace(
-                client=jnp.where(pending_ins, new_client_id, st.client),
-                rbits=jnp.where(
-                    pending_rem, (st.rbits & ~old_bit) | new_bit, st.rbits
-                ),
-                self_client=jnp.int32(new_client_id),
-            )
+            vec.state = adopt_client_slot(vec.state, new_client_id)
 
     def attach(self, runtime) -> None:
         super().attach(runtime)

@@ -337,34 +337,12 @@ class SharedString(SharedObject):
     # -- reconnect rebase (reference regeneratePendingOp, client.ts:917) ------
 
     def on_reconnect(self, new_client_id: int) -> None:
-        """Adopt the new connection's client slot.
-
-        Pending rows must be restamped from the old slot to the new one:
-        client slots recycle, and rows that exist only on this replica
-        (unacked local inserts / removes) would otherwise satisfy the
-        kernel's own-insert fast path (``client == clientn``) or the
-        removers bitmask for the slot's NEXT holder — making remote ops
-        resolve positions differently here than on every other replica."""
-        import jax.numpy as jnp
-
-        from fluidframework_tpu.protocol.constants import UNASSIGNED_SEQ
+        """Adopt the new connection's client slot (see
+        ``segment_state.adopt_client_slot`` for the restamp rationale)."""
+        from fluidframework_tpu.ops.segment_state import adopt_client_slot
 
         self._mint = 0  # content ids scope to the connection ordinal
-        st = self._state
-        old = st.self_client
-        pending_ins = st.seq == UNASSIGNED_SEQ
-        new_client = jnp.where(pending_ins, new_client_id, st.client)
-        pending_rem = st.rlseq > 0
-        old_bit = jnp.int32(1) << jnp.clip(old, 0, 31)
-        new_bit = jnp.int32(1) << jnp.clip(jnp.int32(new_client_id), 0, 31)
-        new_rbits = jnp.where(
-            pending_rem, (st.rbits & ~old_bit) | new_bit, st.rbits
-        )
-        self._state = st._replace(
-            client=new_client,
-            rbits=new_rbits,
-            self_client=jnp.int32(new_client_id),
-        )
+        self._state = adopt_client_slot(self._state, new_client_id)
 
     def begin_resubmit(self) -> None:
         # All regenerations in one batch read the reconnect-time state;
@@ -375,11 +353,9 @@ class SharedString(SharedObject):
         self._rebase_view = None
 
     def _restamp(self, lane: str, rows: list, new_value: int) -> None:
-        import jax.numpy as jnp
+        from fluidframework_tpu.ops.segment_state import restamp_rows
 
-        arr = np.asarray(getattr(self._state, lane)).copy()
-        arr[rows] = new_value
-        self._state = self._state._replace(**{lane: jnp.asarray(arr)})
+        self._state = restamp_rows(self._state, lane, rows, new_value)
 
     def resubmit_core(self, contents: Any, local_metadata: Any) -> None:
         from fluidframework_tpu.runtime.rebase import (

@@ -44,21 +44,10 @@ class SparseMatrix(SharedObject):
         self._rows = _PermutationVector(self._capacity, self.client_id)
 
     def on_reconnect(self, new_client_id: int) -> None:
-        import jax.numpy as jnp
+        from fluidframework_tpu.ops.segment_state import adopt_client_slot
 
         self._mint = 0
-        st = self._rows.state
-        pending_ins = st.seq == UNASSIGNED_SEQ
-        pending_rem = st.rlseq > 0
-        old_bit = jnp.int32(1) << jnp.clip(st.self_client, 0, 31)
-        new_bit = jnp.int32(1) << jnp.clip(jnp.int32(new_client_id), 0, 31)
-        self._rows.state = st._replace(
-            client=jnp.where(pending_ins, new_client_id, st.client),
-            rbits=jnp.where(
-                pending_rem, (st.rbits & ~old_bit) | new_bit, st.rbits
-            ),
-            self_client=jnp.int32(new_client_id),
-        )
+        self._rows.state = adopt_client_slot(self._rows.state, new_client_id)
 
     # -- reads ----------------------------------------------------------------
 
@@ -206,13 +195,9 @@ class SparseMatrix(SharedObject):
             self.submit_local_message(contents, local_metadata)
 
     def _restamp_rows(self, lane: str, rows: List[int], value: int) -> None:
-        import jax.numpy as jnp
+        from fluidframework_tpu.ops.segment_state import restamp_rows
 
-        arr = np.asarray(getattr(self._rows.state, lane)).copy()
-        arr[rows] = value
-        self._rows.state = self._rows.state._replace(
-            **{lane: jnp.asarray(arr)}
-        )
+        self._rows.state = restamp_rows(self._rows.state, lane, rows, value)
 
     # -- summary ---------------------------------------------------------------
 

@@ -159,6 +159,41 @@ def grow(state: SegmentState, new_capacity: int) -> SegmentState:
     )
 
 
+def adopt_client_slot(state: SegmentState, new_client_id: int) -> SegmentState:
+    """Adopt a new connection's client slot after reconnect.
+
+    Pending rows restamp from the old slot to the new one: client slots
+    recycle, and rows that exist only on this replica (unacked local
+    inserts / removes) would otherwise satisfy the kernel's own-insert fast
+    path (``client == clientn``) or the removers bitmask for the slot's
+    NEXT holder — making remote ops resolve positions differently here
+    than on every other replica. Shared by every kernel-backed DDS."""
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.protocol.constants import UNASSIGNED_SEQ
+
+    pending_ins = state.seq == UNASSIGNED_SEQ
+    pending_rem = state.rlseq > 0
+    old_bit = jnp.int32(1) << jnp.clip(state.self_client, 0, 31)
+    new_bit = jnp.int32(1) << jnp.clip(jnp.int32(new_client_id), 0, 31)
+    return state._replace(
+        client=jnp.where(pending_ins, new_client_id, state.client),
+        rbits=jnp.where(
+            pending_rem, (state.rbits & ~old_bit) | new_bit, state.rbits
+        ),
+        self_client=jnp.int32(new_client_id),
+    )
+
+
+def restamp_rows(state: SegmentState, lane: str, rows, value: int) -> SegmentState:
+    """Host-side per-row lane restamp (resubmit bookkeeping)."""
+    import jax.numpy as jnp
+
+    arr = np.asarray(getattr(state, lane)).copy()
+    arr[rows] = value
+    return state._replace(**{lane: jnp.asarray(arr)})
+
+
 def to_host(state: SegmentState) -> "SegmentState":
     """Pull a (single-doc) state to host numpy for materialization/tests."""
     return SegmentState(*[np.asarray(x) for x in state])

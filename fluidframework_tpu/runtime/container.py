@@ -269,6 +269,8 @@ class ContainerRuntime:
                 break  # remainder not yet sequenced; nothing interleaves
             msgs.extend(more)
             self._process_one(more[0])
+            if self._outbox and self.connected:
+                self.flush()  # same creation-context rule as the main loop
         # Nack recovery (reference: nack -> resubmit, §5.3): after a nack,
         # nothing from this connection sequences until we resend, so the
         # entire pending tail regenerates against the caught-up state.

@@ -114,41 +114,45 @@ class LogViewer:
 
 
 def invert_changes(forest_before: Forest, changes: List[dict]) -> List[dict]:
-    """HistoryEditFactory: the inverse edit, derived against the state the
-    edit applied to."""
-    inv: List[dict] = []
-    for ch in reversed(changes):
+    """HistoryEditFactory: the inverse edit. Changes apply sequentially, so
+    each change's inverse derives against the INTERMEDIATE state its
+    predecessors produced (an edit may set a value on the node it just
+    inserted); the inverses then compose in reverse. An edit the forest
+    dropped (constraint/validation) inverts to nothing."""
+    if _apply_changes(forest_before, changes, seq=1) is None:
+        return []  # the edit was a no-op everywhere; so is its undo
+    work = forest_before.clone()
+    inv_rev: List[dict] = []
+    for ch in changes:
         k = ch["k"]
         if k == "ins":
-            inv.extend({"k": "del", "id": n["id"]} for n in reversed(ch["nodes"]))
+            inv_rev.extend(
+                {"k": "del", "id": n["id"]} for n in reversed(ch["nodes"])
+            )
         elif k == "del":
-            n = forest_before.node(ch["id"])
+            n = work.node(ch["id"])
             pid, fname = n.parent
-            kids = forest_before.children(pid, fname)
+            kids = work.children(pid, fname)
             at = kids.index(ch["id"])
-            inv.append(
+            inv_rev.append(
                 {
                     "k": "ins",
                     "parent": pid,
                     "field": fname,
                     "anchor": kids[at - 1] if at > 0 else None,
-                    "nodes": [forest_before.subtree(ch["id"])],
+                    "nodes": [work.subtree(ch["id"])],
                 }
             )
         elif k == "val":
-            inv.append(
-                {
-                    "k": "val",
-                    "id": ch["id"],
-                    "value": forest_before.node(ch["id"]).value,
-                }
+            inv_rev.append(
+                {"k": "val", "id": ch["id"], "value": work.node(ch["id"]).value}
             )
         elif k == "move":
-            n = forest_before.node(ch["id"])
+            n = work.node(ch["id"])
             pid, fname = n.parent
-            kids = forest_before.children(pid, fname)
+            kids = work.children(pid, fname)
             at = kids.index(ch["id"])
-            inv.append(
+            inv_rev.append(
                 {
                     "k": "move",
                     "id": ch["id"],
@@ -157,7 +161,9 @@ def invert_changes(forest_before: Forest, changes: List[dict]) -> List[dict]:
                     "anchor": kids[at - 1] if at > 0 else None,
                 }
             )
-    return inv
+        if k != "constraint":
+            work.apply(ch, 1)
+    return list(reversed(inv_rev))
 
 
 class LegacySharedTree(SharedObject):

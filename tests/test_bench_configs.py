@@ -1,0 +1,40 @@
+"""Smoke: every BASELINE measurement config runs and emits valid JSON."""
+
+import json
+
+import bench_configs as B
+
+
+def run_json(capsys, fn, *a, **kw):
+    fn(*a, **kw)
+    lines = [
+        json.loads(line)
+        for line in capsys.readouterr().out.strip().splitlines()
+    ]
+    assert lines and all("metric" in rec and "value" in rec for rec in lines)
+    return lines[-1]
+
+
+def test_config1(capsys):
+    rec = run_json(capsys, B.config1_single_doc_replay, 120)
+    assert rec["value"] > 0
+
+
+def test_config3(capsys):
+    rec = run_json(capsys, B.config3_tree_rebase, 2, 30)
+    assert rec["value"] > 0
+
+
+def test_config4(capsys):
+    rec = run_json(
+        capsys, B.config4_matrix_axis_merge, n_docs=4, k=16, on_tpu=False
+    )
+    assert rec["errs"] == 0
+
+
+def test_config5(capsys):
+    rec = run_json(
+        capsys, B.config5_deli_scribe_e2e, n_docs=16, ops_per_doc=8,
+        on_tpu=False,
+    )
+    assert rec["errs"] == 0

@@ -352,3 +352,26 @@ def test_view_adapter_detaches():
     a.get_channel("text").insert_text(0, "y")
     drain([a, b])
     assert len(views) == n, "detached adapter must stop rendering"
+
+
+def test_legacy_tree_undo_of_dependent_changes():
+    """Undo of an edit whose later changes reference its earlier inserts
+    (inverses derive against intermediate states)."""
+    svc, (a, b) = setup(lambda: LegacySharedTree("t"))
+    ta, tb = a.get_channel("t"), b.get_channel("t")
+    node = ta._assign_ids({"type": "n"})
+    eid = ta.apply_edit(
+        {"k": "ins", "parent": 0, "field": "kids", "anchor": None,
+         "nodes": [node]},
+        {"k": "val", "id": node["id"], "value": 7},
+    )
+    drain([a, b])
+    ta.undo(eid)
+    drain([a, b])
+    assert ta.current_view() == tb.current_view()
+    assert not ta.current_view().get("fields", {}).get("kids")
+
+    # Undo of a dropped edit is a no-op (returns None, nothing sent).
+    eid2 = ta.apply_edit({"k": "del", "id": 999999})
+    drain([a, b])
+    assert ta.undo(eid2) is None
