@@ -38,3 +38,11 @@ def test_config5(capsys):
         on_tpu=False,
     )
     assert rec["errs"] == 0
+
+
+def test_config2b_latency(capsys):
+    rec = run_json(
+        capsys, B.config2b_apply_latency, n_docs=8, k=8, steps=5,
+        on_tpu=False,
+    )
+    assert rec["p99_ms"] > 0
