@@ -83,6 +83,19 @@ class FencedOpLog:
         start = max(0, from_seq - log[0].sequence_number + 1)
         return log[start:]
 
+    def truncate(self, doc_id: str, below_seq: int) -> int:
+        """Drop ops at or below ``below_seq`` (summary-gated log
+        truncation; the reference's scribe protocolHead semantics). The
+        caller must ensure no consumer can still need them (acked summary
+        covers them AND the MSN has passed them)."""
+        log = self._log.get(doc_id)
+        if not log:
+            return 0
+        drop = max(0, min(len(log), below_seq - log[0].sequence_number + 1))
+        if drop:
+            self._log[doc_id] = log[drop:]
+        return drop
+
 
 class CheckpointTable:
     """Shared sequencer-checkpoint store (the Mongo IDeliState analog),
@@ -424,6 +437,19 @@ class MultiNodeFluidService:
             contents=contents,
         )
         node._emit(doc_id, ack)
+        if ok:
+            # Summary-gated log truncation: ops covered by the acked
+            # summary AND below the collab window can never be needed again
+            # (cold starts load the summary; live refs are >= MSN). Force a
+            # fresh checkpoint first so crash-recovery replay never reaches
+            # for truncated ops.
+            seqr = node._docs[doc_id]
+            cut = min(contents["head"], seqr.min_seq)
+            if cut > 0:
+                self.cluster.checkpoints.save(
+                    doc_id, node._epochs[doc_id], seqr.checkpoint()
+                )
+                self.cluster.op_log.truncate(doc_id, cut)
 
     def _deliver(self, doc_id: str) -> None:
         for c in self.rooms.get(doc_id, []):
