@@ -369,10 +369,24 @@ class MultiNodeFluidService:
         if from_seq == 0 and scribe and scribe.get("latest"):
             conn.initial_summary = tuple(scribe["latest"])
             from_seq = scribe["latest"][1]
+        self._check_retained(doc_id, from_seq)
         conn.delivered_seq = from_seq
         self.rooms.setdefault(doc_id, []).append(conn)
         self._deliver(doc_id)
         return conn
+
+    def _check_retained(self, doc_id: str, from_seq: int) -> None:
+        """Summary-gated truncation may have dropped ops a long-offline
+        client would need: resuming below the retained window must fail
+        loudly (the reference forces a reload from the latest snapshot)
+        rather than silently skipping the gap."""
+        log = self.cluster.op_log._log.get(doc_id)
+        if log and from_seq + 1 < log[0].sequence_number:
+            raise ConnectionError(
+                f"resume point {from_seq} is below the retained op window "
+                f"(starts at {log[0].sequence_number}); reload the document "
+                "from the latest summary"
+            )
 
     def disconnect(self, doc_id: str, client_id: int) -> None:
         self.rooms[doc_id] = [
@@ -413,6 +427,7 @@ class MultiNodeFluidService:
             c.signals.append(sig)
 
     def get_deltas(self, doc_id: str, from_seq: int = 0, to_seq=None):
+        self._check_retained(doc_id, from_seq)
         return [
             m
             for m in self.cluster.op_log.read(doc_id, from_seq)

@@ -203,3 +203,37 @@ def test_summary_gated_log_truncation():
     drain([a, b, late])
     texts = {rt.get_channel("t").get_text() for rt in (a, b, late)}
     assert len(texts) == 1 and texts.pop().startswith("failover-")
+
+
+def test_reconnect_below_retained_window_fails_loudly():
+    """A long-offline client whose resume point predates truncation gets a
+    clear ConnectionError (reload from summary), never a silent gap."""
+    clock = Clock()
+    svc = MultiNodeFluidService(n_nodes=2, clock=clock)
+    a = ContainerRuntime(svc, "doc", channels=(SharedString("t"),))
+    b = ContainerRuntime(svc, "doc", channels=(SharedString("t"),))
+    a.get_channel("t").insert_text(0, "early")
+    drain([a, b])
+    b.disconnect()
+    for i in range(4):
+        a.get_channel("t").insert_text(0, f"{i}-")
+        drain([a])
+    a.submit_summary()
+    drain([a])
+    a.send_noop()
+    drain([a])
+    a.get_channel("t").insert_text(0, "post-")
+    drain([a])
+    a.submit_summary()
+    drain([a])
+    if len(svc.cluster.op_log.read("doc")) == 0:
+        pytest.skip("truncation did not fire in this schedule")
+    first_retained = svc.cluster.op_log.read("doc")[0].sequence_number
+    if b.ref_seq + 1 >= first_retained:
+        pytest.skip("b's resume point still inside the window")
+    with pytest.raises(ConnectionError, match="retained op window"):
+        b.reconnect()
+    # A fresh load (from the summary) works fine.
+    fresh = ContainerRuntime(svc, "doc", channels=(SharedString("t"),))
+    drain([a, fresh])
+    assert fresh.get_channel("t").get_text() == a.get_channel("t").get_text()
