@@ -62,6 +62,27 @@ class NodeProxy:
     def value(self, v) -> None:
         self._tree.set_value(self._id, v)
 
+    @property
+    def insert_seq(self) -> int:
+        """Sequence number that inserted this node (0 while pending) — join
+        with an OpStreamAttributor for who/when (the attributor story for
+        tree content; reference attributor.ts keys attribution by seq)."""
+        v = self._tree._view
+        n = v.node(self._id)
+        if n.parent is None:
+            return 0
+        pid, fname = n.parent
+        for e in v.node(pid).fields.get(fname, []):
+            if e.node_id == self._id and e.deleted_seq is None:
+                return 0 if e.seq >= (1 << 59) else e.seq
+        return 0
+
+    @property
+    def value_seq(self) -> int:
+        """Sequence number of the last value write (0 while pending)."""
+        s = self._tree._view.node(self._id).value_seq
+        return 0 if s < 0 or s >= (1 << 59) else s
+
     def field(self, name: str) -> "FieldProxy":
         return FieldProxy(self._tree, self._id, name)
 

@@ -335,3 +335,29 @@ def test_chunking_rejects_polymorphic_fields():
     assert not any(isinstance(c, UniformChunk) for c in chunks), (
         "different child counts must not chunk together"
     )
+
+
+def test_tree_attribution_via_op_stream():
+    """Node seq stamps join with the OpStreamAttributor: who inserted a
+    node and who last wrote its value."""
+    from fluidframework_tpu.framework.attributor import OpStreamAttributor
+
+    svc, (a, b) = setup()
+    ta, tb = tree_of(a), tree_of(b)
+    attr_b = OpStreamAttributor(b)
+    (n,) = ta.root["f"].append({"type": "n", "value": "original"})
+    drain([a, b])
+    node_b = tb.root["f"][0]
+    ins_seq = node_b.insert_seq
+    assert ins_seq > 0
+    who = attr_b.get(ins_seq)
+    assert who is not None and who[0] == a.client_id
+
+    tb.set_value(node_b.node_id, "edited-by-b")
+    drain([a, b])
+    val_seq = tb.root["f"][0].value_seq
+    assert val_seq > ins_seq
+    assert attr_b.get(val_seq)[0] == b.client_id
+    # Pending local edits attribute to nobody yet (seq 0).
+    ta.root["f"].append({"type": "n", "value": "pending"})
+    assert ta.root["f"][1].insert_seq == 0
