@@ -65,7 +65,12 @@ def apply_and_stats(state: SegmentState, ops: jnp.ndarray):
 
 class DocShard:
     """A mesh-resident fleet of documents — the compute backend the service
-    layer feeds with sequenced op batches (the ``TpuDeliLambda`` target)."""
+    layer feeds with sequenced op batches (the ``TpuDeliLambda`` target).
+
+    ``backend="xla"`` runs the vmapped XLA kernels under jit-with-shardings;
+    ``backend="pallas"`` runs the VMEM-resident Pallas kernels per shard
+    under ``shard_map`` (each device owns its doc slice; only the telemetry
+    reduction crosses shards). Both produce bit-identical states."""
 
     def __init__(
         self,
@@ -73,23 +78,139 @@ class DocShard:
         capacity: int,
         mesh: Optional[Mesh] = None,
         axis: str = "docs",
+        backend: str = "xla",
+        interpret: Optional[bool] = None,
     ):
+        assert backend in ("xla", "pallas"), f"unknown backend {backend!r}"
         self.mesh = mesh or make_mesh(axis=axis)
         self.axis = axis
+        self.backend = backend
         n_dev = self.mesh.devices.size
         assert n_docs % n_dev == 0, (
             f"n_docs={n_docs} must divide evenly over {n_dev} devices"
         )
-        self.state = shard_state(
-            make_batched_state(n_docs, capacity, NO_CLIENT), self.mesh, axis
+        self._docs_per_dev = n_docs // n_dev
+        full = make_batched_state(n_docs, capacity, NO_CLIENT)
+        if backend == "pallas":
+            from fluidframework_tpu.ops.pallas_kernel import _on_tpu, pack_state
+
+            self._interpret = (
+                (not _on_tpu()) if interpret is None else interpret
+            )
+            tables, scalars = pack_state(full)
+            ts = NamedSharding(self.mesh, P(None, axis, None))
+            ss = NamedSharding(self.mesh, P(axis, None))
+            self._tables = jax.device_put(tables, ts)
+            self._scalars = jax.device_put(scalars, ss)
+            self._pallas_step = self._make_pallas_step()
+            self._pallas_compact = self._make_pallas_compact()
+        else:
+            self.state = shard_state(full, self.mesh, axis)
+            self._step = jax.jit(apply_and_stats, donate_argnums=(0,))
+
+    # -- pallas backend -------------------------------------------------------
+
+    def _make_pallas_step(self):
+        from jax import shard_map
+
+        from fluidframework_tpu.ops.pallas_kernel import (
+            SC_COUNT,
+            SC_CUR_SEQ,
+            SC_ERR,
+            SC_MIN_SEQ,
+            apply_ops_packed,
         )
-        self._step = jax.jit(apply_and_stats, donate_argnums=(0,))
+
+        axis = self.axis
+        blk = min(32, self._docs_per_dev)
+        while self._docs_per_dev % blk != 0:
+            blk //= 2
+        interpret = self._interpret
+
+        def per_shard(tables, scalars, ops):
+            tables, scalars = apply_ops_packed(
+                tables, scalars, ops, block_docs=blk, interpret=interpret
+            )
+            stats = {
+                "rows_in_use": jax.lax.psum(
+                    jnp.sum(scalars[:, SC_COUNT]), axis
+                ),
+                "docs_with_errors": jax.lax.psum(
+                    jnp.sum((scalars[:, SC_ERR] != 0).astype(jnp.int32)), axis
+                ),
+                "max_seq": jax.lax.pmax(
+                    jnp.max(scalars[:, SC_CUR_SEQ]), axis
+                ),
+                "min_window": jax.lax.pmin(
+                    jnp.min(scalars[:, SC_MIN_SEQ]), axis
+                ),
+            }
+            return tables, scalars, stats
+
+        return jax.jit(
+            shard_map(
+                per_shard,
+                mesh=self.mesh,
+                in_specs=(P(None, axis, None), P(axis, None),
+                          P(axis, None, None)),
+                out_specs=(P(None, axis, None), P(axis, None), P()),
+                check_vma=False,  # pallas_call outputs carry no vma info
+            ),
+            donate_argnums=(0, 1),
+        )
+
+    def _make_pallas_compact(self):
+        from jax import shard_map
+
+        from fluidframework_tpu.ops.pallas_compact import compact_packed
+
+        axis = self.axis
+        interpret = self._interpret
+
+        def per_shard(tables, scalars):
+            return compact_packed(tables, scalars, interpret=interpret)
+
+        return jax.jit(
+            shard_map(
+                per_shard,
+                mesh=self.mesh,
+                in_specs=(P(None, axis, None), P(axis, None)),
+                out_specs=(P(None, axis, None), P(axis, None)),
+                check_vma=False,
+            ),
+            donate_argnums=(0, 1),
+        )
+
+    @property
+    def packed(self):
+        assert self.backend == "pallas"
+        return self._tables, self._scalars
+
+    def unpacked_state(self) -> SegmentState:
+        """The fleet as a SegmentState (pallas backend: unpack on demand)."""
+        if self.backend == "pallas":
+            from fluidframework_tpu.ops.pallas_kernel import unpack_state
+
+            return unpack_state(self._tables, self._scalars)
+        return self.state
+
+    # -- the service step -----------------------------------------------------
 
     def apply(self, ops: np.ndarray):
         """ops: [D, K, OP_WIDTH] int32 sequenced rows (NOOP-padded)."""
         sharded = shard_ops(jnp.asarray(ops, jnp.int32), self.mesh, self.axis)
+        if self.backend == "pallas":
+            self._tables, self._scalars, stats = self._pallas_step(
+                self._tables, self._scalars, sharded
+            )
+            return stats
         self.state, stats = self._step(self.state, sharded)
         return stats
 
     def compact(self) -> None:
-        self.state = batched_compact(self.state)
+        if self.backend == "pallas":
+            self._tables, self._scalars = self._pallas_compact(
+                self._tables, self._scalars
+            )
+        else:
+            self.state = batched_compact(self.state)

@@ -87,3 +87,32 @@ def test_mesh_uses_all_devices():
     # The doc axis must actually be distributed across devices.
     lane = shard.state.kind
     assert len(lane.sharding.device_set) == 8
+
+def test_pallas_backend_matches_xla_on_mesh():
+    """DocShard's Pallas backend under shard_map is bit-identical to the
+    XLA backend across an 8-device mesh (stats and every lane)."""
+    import numpy as np
+
+    from fluidframework_tpu.ops.segment_state import SEGMENT_LANES
+    from fluidframework_tpu.parallel.mesh import DocShard, make_mesh
+
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from __graft_entry__ import _example_ops
+
+    mesh = make_mesh(8)
+    a = DocShard(n_docs=32, capacity=128, mesh=mesh, backend="xla")
+    b = DocShard(n_docs=32, capacity=128, mesh=mesh, backend="pallas")
+    ops = _example_ops(32, 8)
+    sa, sb = a.apply(ops), b.apply(ops)
+    assert {k: int(v) for k, v in sa.items()} == {
+        k: int(v) for k, v in sb.items()
+    }
+    a.compact()
+    b.compact()
+    ub = b.unpacked_state()
+    for k in SEGMENT_LANES + ("count", "min_seq", "cur_seq", "err"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.state, k)), np.asarray(getattr(ub, k)),
+            err_msg=k,
+        )
