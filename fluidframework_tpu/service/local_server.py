@@ -74,6 +74,11 @@ class _DocState:
         # client summary and the protocol head it advanced to.
         self.latest_summary: Optional[tuple] = None  # (handle, seq)
         self.protocol_head = 0
+        # Service summaries (scribe/lambda.ts:304): periodic logTail blobs
+        # written by the SERVICE so storage alone can reconstruct the
+        # stream even when no client ever summarizes.
+        self.service_summaries: List[tuple] = []  # (handle, from_seq, to_seq)
+        self.service_summary_head = 0
 
 
 class LocalFluidService:
@@ -85,9 +90,11 @@ class LocalFluidService:
         self,
         store: Optional[SummaryStore] = None,
         messages_per_trace: int = 0,
+        service_summary_every: int = 0,  # ops per service summary; 0 = off
     ) -> None:
         self.docs: Dict[str, _DocState] = {}
         self.store = store or SummaryStore()
+        self.service_summary_every = service_summary_every
         # Sampled op tracing at the front door (alfred stamps 1-in-N,
         # reference config.json:58 numberOfMessagesPerTrace; 0 = off).
         self.trace_sampler = (
@@ -208,6 +215,41 @@ class LocalFluidService:
         doc.op_log.append(msg)
         for conn in doc.connections.values():
             conn.inbox.append(msg)
+        if (
+            self.service_summary_every
+            and msg.sequence_number - doc.service_summary_head
+            >= self.service_summary_every
+        ):
+            self._write_service_summary(doc)
+
+    def _write_service_summary(self, doc: _DocState) -> None:
+        """Write the op tail since the last service summary as a durable
+        blob (the scribe's periodic service summary — storage alone can
+        then reconstruct the stream without any client summarizer)."""
+        from fluidframework_tpu.service.codec import encode_value
+
+        tail = [
+            m
+            for m in doc.op_log
+            if m.sequence_number > doc.service_summary_head
+        ]
+        if not tail:
+            return
+        handle = self.store.put_blob(encode_value(tail))
+        doc.service_summaries.append(
+            (handle, doc.service_summary_head, tail[-1].sequence_number)
+        )
+        doc.service_summary_head = tail[-1].sequence_number
+
+    def read_service_summaries(self, doc_id: str) -> List[SequencedDocumentMessage]:
+        """Reconstruct the sequenced stream purely from service-summary
+        blobs (the storage-only recovery path)."""
+        from fluidframework_tpu.service.codec import decode_value
+
+        out: List[SequencedDocumentMessage] = []
+        for handle, _from, _to in self._doc(doc_id).service_summaries:
+            out.extend(decode_value(self.store.get_blob(handle)))
+        return out
 
     # -- delta storage (historical op fetch, driver storage.ts:81) -----------
 
