@@ -237,6 +237,34 @@ class IntervalCollection:
             for iv in self._intervals.values()
         )
 
+    # -- searches (reference IntervalCollection.findOverlappingIntervals /
+    # nextInterval / previousInterval; intervalCollection.ts) ---------------
+
+    def find_overlapping(self, start: int, end: int) -> List[str]:
+        """Ids of intervals whose [start, end] range intersects the query
+        range (inclusive ends, like the reference's overlap search)."""
+        out = []
+        for iv_id, s, e, _props in self.all():
+            if s <= end and e >= start and s >= 0 and e >= 0:
+                out.append(iv_id)
+        return out
+
+    def next_interval(self, pos: int) -> Optional[str]:
+        """The interval with the smallest start at or after ``pos``."""
+        best = None
+        for iv_id, s, _e, _props in self.all():
+            if s >= max(pos, 0) and (best is None or s < best[0]):
+                best = (s, iv_id)  # detached intervals (s < 0) never match
+        return best[1] if best else None
+
+    def previous_interval(self, pos: int) -> Optional[str]:
+        """The interval with the largest start at or before ``pos``."""
+        best = None
+        for iv_id, s, _e, _props in self.all():
+            if 0 <= s <= pos and (best is None or s > best[0]):
+                best = (s, iv_id)
+        return best[1] if best else None
+
     # -- local edits ---------------------------------------------------------
 
     def add(

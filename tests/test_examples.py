@@ -1,0 +1,28 @@
+"""The shipped examples run end-to-end (reference examples/ apps)."""
+
+import os
+import subprocess
+import sys
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def run_example(name: str) -> str:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, name)],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    return out.stdout
+
+
+def test_collab_editor_example():
+    out = run_example("collab_editor.py")
+    assert "converged text" in out
+
+
+def test_presence_tracker_example():
+    out = run_example("presence_tracker.py")
+    assert "transient" in out

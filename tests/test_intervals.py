@@ -246,3 +246,36 @@ def test_concurrent_same_field_latest_seq_wins():
     # Both replicas agree; the later-sequenced change holds the field.
     assert col_a.resolve(iid) == col_b.resolve(iid)
     assert col_a.resolve(iid)[0] in (2, 5)
+
+
+def test_interval_searches():
+    """findOverlappingIntervals / nextInterval / previousInterval."""
+    from fluidframework_tpu.models.shared_string import SharedString
+    from fluidframework_tpu.runtime.container import ContainerRuntime
+    from fluidframework_tpu.service.local_server import LocalFluidService
+
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "d", channels=(SharedString("t"),))
+    s = a.get_channel("t")
+    s.insert_text(0, "abcdefghij")
+    a.flush()
+    a.process_incoming()
+    col = s.get_interval_collection("marks")
+    i1 = col.add(1, 3)
+    i2 = col.add(4, 6)
+    i3 = col.add(8, 9)
+    a.flush()
+    a.process_incoming()
+
+    assert set(col.find_overlapping(2, 5)) == {i1, i2}
+    assert col.find_overlapping(7, 7) == []
+    assert col.next_interval(4) == i2
+    assert col.next_interval(7) == i3
+    assert col.next_interval(50) is None
+    assert col.previous_interval(3) == i1
+    assert col.previous_interval(0) is None
+    # Searches track sliding positions through edits.
+    s.remove_range(0, 2)  # i1 start slides
+    a.flush()
+    a.process_incoming()
+    assert col.previous_interval(0) == i1
