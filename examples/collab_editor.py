@@ -19,7 +19,7 @@ from fluidframework_tpu.runtime.container import ContainerRuntime
 from fluidframework_tpu.service.network_server import FluidNetworkServer
 
 
-def drain(runtimes, timeout=15.0):
+def drain(runtimes, timeout=60.0):
     """Flush, then poll to quiescence with a deadline (socket delivery is
     asynchronous — three consecutive quiet rounds means settled)."""
     import time

@@ -375,3 +375,62 @@ def test_legacy_tree_undo_of_dependent_changes():
     eid2 = ta.apply_edit({"k": "del", "id": 999999})
     drain([a, b])
     assert ta.undo(eid2) is None
+
+
+def test_property_array_ot_converges():
+    """ArrayProperty positional OT: concurrent inserts/removes converge
+    with later-writer-first tie order and remove annihilation."""
+    svc, (a, b) = setup(lambda: SharedPropertyTree("p"))
+    pa, pb = a.get_channel("p"), b.get_channel("p")
+    pa.insert_array_property("tags", ["x", "y", "z"])
+    pa.commit()
+    drain([a, b])
+    assert pb.get("tags") == ["x", "y", "z"]
+
+    # Concurrent: a inserts at front, b removes the middle.
+    pa.array_insert("tags", 0, ["a0"])
+    pa.commit()
+    a.flush()
+    pb.array_remove("tags", 1)  # removes "y" in b's view
+    pb.commit()
+    drain([a, b])
+    assert pa.get("tags") == pb.get("tags") == ["a0", "x", "z"]
+
+    # Concurrent removes of the same element annihilate (no double kill).
+    pa.array_remove("tags", 1)
+    pa.commit()
+    a.flush()
+    pb.array_remove("tags", 1)
+    pb.commit()
+    drain([a, b])
+    assert pa.get("tags") == pb.get("tags") == ["a0", "z"]
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_property_array_fuzz(seed):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    svc, rts = setup(lambda: SharedPropertyTree("p"), n=3)
+    docs = [rt.get_channel("p") for rt in rts]
+    docs[0].insert_array_property("arr", [0, 1, 2, 3])
+    docs[0].commit()
+    drain(rts)
+    for step in range(80):
+        i = int(rng.integers(0, 3))
+        d = docs[i]
+        arr = d.get("arr") or []
+        if arr and rng.random() < 0.4:
+            d.array_remove("arr", int(rng.integers(0, len(arr))))
+        else:
+            d.array_insert("arr", int(rng.integers(0, len(arr) + 1)),
+                           [100 + step])
+        d.commit()
+        if step % 3 == 0:
+            rts[i].flush()
+        if step % 5 == 0:
+            for rt in rts:
+                rt.process_incoming()
+    drain(rts)
+    vals = [d.get("arr") for d in docs]
+    assert vals[0] == vals[1] == vals[2]
