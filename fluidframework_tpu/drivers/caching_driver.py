@@ -20,6 +20,7 @@ Here the cache wraps ANY inner service (local, network, multinode):
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from typing import Any, Dict, List, Optional
@@ -42,8 +43,14 @@ class PersistentCache:
 
     # -- per-document snapshot/op-tail entries -------------------------------
 
+    @staticmethod
+    def _fs_name(key: str) -> str:
+        # Handles and doc ids come from the (untrusted) service; never use
+        # them as filenames — a '/' or '..' would escape the cache dir.
+        return hashlib.sha256(key.encode()).hexdigest()
+
     def _doc_path(self, doc_id: str) -> str:
-        return os.path.join(self.dir, f"doc-{doc_id}.json")
+        return os.path.join(self.dir, f"doc-{self._fs_name(doc_id)}.json")
 
     def get_doc(self, doc_id: str) -> Optional[dict]:
         if doc_id in self._docs:
@@ -71,7 +78,7 @@ class PersistentCache:
         if handle in self._blobs:
             return self._blobs[handle]
         if self.dir:
-            p = os.path.join(self.dir, "blobs", handle)
+            p = os.path.join(self.dir, "blobs", self._fs_name(handle))
             if os.path.exists(p):
                 with open(p, "rb") as f:
                     self._blobs[handle] = f.read()
@@ -83,13 +90,14 @@ class PersistentCache:
         if handle in self._blobs:
             return True
         return bool(self.dir) and os.path.exists(
-            os.path.join(self.dir, "blobs", handle)
+            os.path.join(self.dir, "blobs", self._fs_name(handle))
         )
 
     def put_blob(self, handle: str, data: bytes) -> None:
         self._blobs[handle] = data
         if self.dir:
-            with open(os.path.join(self.dir, "blobs", handle), "wb") as f:
+            p = os.path.join(self.dir, "blobs", self._fs_name(handle))
+            with open(p, "wb") as f:
                 f.write(data)
 
 

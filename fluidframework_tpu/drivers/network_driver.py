@@ -167,7 +167,9 @@ class NetworkConnection:
                 if not data:
                     return
                 frames = self._decoder.feed(data)
-        except OSError:
+        except (OSError, ValueError):
+            # OSError: socket died; ValueError: peer violated the frame
+            # protocol (oversized/malformed) — either way the stream is dead.
             pass
         finally:
             self.closed = True

@@ -91,8 +91,9 @@ def squash(first: dict, second: dict) -> dict:
     """Compose: apply(doc, squash(a, b)) == apply(apply(doc, a), b)."""
     out = copy.deepcopy(first)
     out.setdefault("arrays", {})
-    for path, aops in second.get("arrays", {}).items():
-        out["arrays"].setdefault(path, []).extend(copy.deepcopy(aops))
+    # Mirror apply_changeset's remove→insert→modify→arrays order: second's
+    # removes must strip state BEFORE its own array ops merge in, or a
+    # remove+reinsert+array-edit of the same path drops its own array ops.
     for path in second["remove"]:
         # The remove cancels only when the removed path ITSELF was created
         # by the first changeset (insert+remove = net nothing). Descendant
@@ -119,6 +120,8 @@ def squash(first: dict, second: dict) -> dict:
             out["insert"][path] = (out["insert"][path][0], copy.deepcopy(v))
         else:
             out["modify"][path] = copy.deepcopy(v)
+    for path, aops in second.get("arrays", {}).items():
+        out["arrays"].setdefault(path, []).extend(copy.deepcopy(aops))
     return out
 
 

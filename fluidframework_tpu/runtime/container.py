@@ -68,6 +68,11 @@ class ContainerRuntime:
             self.client_id + 1  # mock services without ordinals don't recycle
         )
         self._offline: list = []  # ops authored while disconnected
+        self._offline_folded = 0  # prefix of _offline from resolved drops
+        self._offline_proposals: list = []  # proposals made while offline
+        # Proposals submitted but not yet seen sequenced: (cseq, key, value).
+        # Tracked so a dropped connection can recover them like pending ops.
+        self._inflight_proposals: deque = deque()
         self.channels: Dict[str, SharedObject] = {}
         self.ref_seq = 0  # last processed sequence number
         self.min_seq = 0
@@ -76,11 +81,20 @@ class ContainerRuntime:
         # FIFO of (client_seq, channel_id, contents, local_metadata):
         # reference PendingStateManager semantics.
         self.pending: deque = deque()
+        # Ungraceful-drop recovery: one entry per dead connection that still
+        # has in-flight state of unknown fate — resolved during reconnect
+        # catch-up (see drop_connection()). Each generation carries
+        # {client_id, join_seq, pending, proposals} (+ resolved flag; the
+        # synthetic offline generation uses entries instead of pending).
+        # Echo matching needs no upper bound: a client id cannot recycle
+        # before its LEAVE, and the LEAVE is what resolves the generation.
+        self._prior_gens: list = []
         self._outbox: list = []
         self.compression_threshold = compression_threshold
         self.chunk_size = chunk_size
         self._rmp = RemoteMessageProcessor()
         self._open_batch = False  # inbound batch in flight (ScheduleManager)
+        self._open_batch_client: Optional[int] = None  # who opened it
         self.quorum_members: Dict[int, dict] = {}
         # Quorum proposals: pending by seq; approved key -> value.
         self.pending_proposals: Dict[int, tuple] = {}
@@ -153,15 +167,35 @@ class ContainerRuntime:
             self._send_attach(channel.id, type_name, root)
         return channel
 
-    def _send_attach(self, cid: str, type_name: str, root: bool) -> None:
+    def _submit_system(self, type_: MessageType, contents: Any = None) -> bool:
+        """Submit a non-channel message (noop/propose/attach/summarize).
+        On a dead connection, mark the runtime disconnected instead of
+        crashing the caller — the drop/reconnect recovery path takes over.
+        Returns False iff the connection was dead."""
+        if not self.connected:
+            return False
         self.client_seq += 1
-        self.connection.submit(
-            DocumentMessage(
-                client_sequence_number=self.client_seq,
-                reference_sequence_number=self.ref_seq,
-                type=MessageType.ATTACH,
-                contents={"id": cid, "type": type_name, "root": root},
+        try:
+            self.connection.submit(
+                DocumentMessage(
+                    client_sequence_number=self.client_seq,
+                    reference_sequence_number=self.ref_seq,
+                    type=type_,
+                    contents=contents,
+                )
             )
+            return True
+        except OSError:  # ConnectionError or a raw socket error (EBADF…)
+            self.client_seq -= 1
+            self.connected = False
+            return False
+
+    def _send_attach(self, cid: str, type_name: str, root: bool) -> None:
+        # Stays in _pending_attaches until its echo: a failed send simply
+        # re-announces on reconnect.
+        self._submit_system(
+            MessageType.ATTACH,
+            {"id": cid, "type": type_name, "root": root},
         )
 
     def _resend_pending_attaches(self) -> None:
@@ -223,22 +257,42 @@ class ContainerRuntime:
             {"address": channel_id, "contents": contents}
             for channel_id, contents, _meta in batch
         ]
-        for w in pack_batch(envelopes, self.compression_threshold, self.chunk_size):
+        wire = pack_batch(envelopes, self.compression_threshold, self.chunk_size)
+        for wi, w in enumerate(wire):
             self.client_seq += 1
             if w.logical_index is not None:
                 channel_id, contents, local_metadata = batch[w.logical_index]
                 self.pending.append(
                     (self.client_seq, channel_id, contents, local_metadata)
                 )
-            self.connection.submit(
-                DocumentMessage(
-                    client_sequence_number=self.client_seq,
-                    reference_sequence_number=self.ref_seq,
-                    type=MessageType.OPERATION,
-                    contents=w.contents,
-                    metadata=w.metadata,
+            try:
+                self.connection.submit(
+                    DocumentMessage(
+                        client_sequence_number=self.client_seq,
+                        reference_sequence_number=self.ref_seq,
+                        type=MessageType.OPERATION,
+                        contents=w.contents,
+                        metadata=w.metadata,
+                    )
                 )
-            )
+            except OSError:
+                # The connection died under us (idle eviction, socket drop —
+                # ConnectionError or a raw socket error): this wire message
+                # and everything after it never reached the service. Unwind
+                # them into the offline buffer and mark the runtime
+                # disconnected; anything already on the wire resolves
+                # through the drop/reconnect prior-echo path.
+                self.client_seq -= 1
+                if w.logical_index is not None:
+                    self.pending.pop()
+                unsent = sorted(
+                    x.logical_index
+                    for x in wire[wi:]
+                    if x.logical_index is not None
+                )
+                self._offline.extend(batch[i] for i in unsent)
+                self.connected = False
+                return
 
     # -- inbound (process, §3.2) ----------------------------------------------
 
@@ -287,15 +341,33 @@ class ContainerRuntime:
             self._resend_pending_attaches()
             tail = list(self.pending)
             self.pending.clear()
-            for ch in self.channels.values():
-                ch.begin_resubmit()
-            for _cseq, channel_id, contents, local_metadata in tail:
-                self.channels[channel_id].resubmit_core(contents, local_metadata)
-            for ch in self.channels.values():
-                ch.end_resubmit()
+            self._regenerate_through_channels(
+                (chan, contents, meta) for _cseq, chan, contents, meta in tail
+            )
             batch, self._outbox = self._outbox, []
             self._send_batch(batch)
+            # Proposals behind the nack were rejected too: re-propose the
+            # ones whose echoes didn't arrive during the catch-up above.
+            inflight, self._inflight_proposals = (
+                self._inflight_proposals,
+                deque(),
+            )
+            for _cseq, key, value in inflight:
+                self.propose(key, value)
         return len(msgs)
+
+    def _regenerate_through_channels(self, entries) -> None:
+        """Replay (channel_id, contents, local_metadata) entries through the
+        per-channel resubmit path (reference reSubmitCore): each channel
+        regenerates the op against current state rather than re-sending it
+        verbatim. Shared by nack recovery, reconnect, and dropped-connection
+        resolution."""
+        for ch in self.channels.values():
+            ch.begin_resubmit()
+        for channel_id, contents, local_metadata in entries:
+            self.channels[channel_id].resubmit_core(contents, local_metadata)
+        for ch in self.channels.values():
+            ch.end_resubmit()
 
     def _is_own_echo(self, msg: SequencedDocumentMessage) -> bool:
         """True iff this sequenced message is this connection's own op."""
@@ -303,6 +375,22 @@ class ContainerRuntime:
             msg.client_id == self.client_id
             and msg.sequence_number > self._join_seq
         )
+
+    def _match_prior_gen(self, msg: SequencedDocumentMessage):
+        """The dropped-connection generation this message belongs to, if
+        any. While a generation is unresolved its LEAVE has not sequenced,
+        so the service cannot have recycled its client id — a client-id
+        match (above the generation's own JOIN) is unambiguous, even for
+        in-flight ops an async server sequences after our successor JOIN.
+        (_is_own_echo is checked first; our current id can only equal a
+        gen's id after that gen resolved.)"""
+        for gen in self._prior_gens:
+            if (
+                msg.client_id == gen["client_id"]
+                and msg.sequence_number > gen["join_seq"]
+            ):
+                return gen
+        return None
 
     def _process_one(self, msg: SequencedDocumentMessage) -> None:
         assert (
@@ -313,8 +401,10 @@ class ContainerRuntime:
         meta = msg.metadata or {}
         if meta.get("batchBegin"):
             self._open_batch = True
+            self._open_batch_client = msg.client_id
         if meta.get("batchEnd"):
             self._open_batch = False
+            self._open_batch_client = None
         # Every sequenced message from this client consumed a server-side
         # clientSequenceNumber slot — PROPOSE/NOOP/SUMMARIZE included — so
         # nack recovery must never reuse a number at or below it. Identity
@@ -342,9 +432,36 @@ class ContainerRuntime:
                 "join_seq": msg.sequence_number,
             }
         elif msg.type == MessageType.CLIENT_LEAVE:
-            self.quorum_members.pop(msg.contents, None)
+            member = self.quorum_members.pop(msg.contents, None)
+            # Drop any partial chunk/batch accumulators the departed client
+            # left behind — its slot may recycle to a client whose fresh
+            # chunk stream must not collide with the corpse's.
+            self._rmp.forget_client(msg.contents)
+            if self._open_batch and self._open_batch_client == msg.contents:
+                # The batch opener died mid-batch: its batchEnd will never
+                # arrive. Un-latch, or every subsequent process_incoming
+                # would drain the whole inbox chasing a phantom end.
+                self._open_batch = False
+                self._open_batch_client = None
             for ch in self.channels.values():
                 ch.on_client_leave(msg.contents)
+            for gen in self._prior_gens:
+                if msg.contents != gen["client_id"]:
+                    continue
+                # Exact match: the quorum records WHICH holder of the slot
+                # left (by its join seq). Quorum-less fallback: the oldest
+                # generation for this id — LEAVEs arrive in holder order,
+                # and resolving the oldest beats leaking its ops forever
+                # (the LEAVE itself may sequence after our reconnect, so no
+                # upper-bound window applies to it).
+                if (
+                    member is None
+                    or member.get("join_seq") == gen["join_seq"]
+                ):
+                    # That connection's LEAVE: nothing more from it can
+                    # arrive, so its unresolved remainder resubmits.
+                    self._resolve_prior_connection(gen)
+                    break
             self._check_proposals()
         elif msg.type == MessageType.ATTACH:
             # Dynamic channel creation: the attaching client already has it;
@@ -362,6 +479,16 @@ class ContainerRuntime:
             # connected client has seen it).
             key, value = msg.contents["key"], msg.contents["value"]
             self.pending_proposals[msg.sequence_number] = (key, value)
+            # Retire the in-flight record (ours, or a dropped connection's).
+            if self._is_own_echo(msg) and self._inflight_proposals:
+                if self._inflight_proposals[0][0] == msg.client_sequence_number:
+                    self._inflight_proposals.popleft()
+            elif (gen := self._match_prior_gen(msg)) is not None:
+                if (
+                    gen["proposals"]
+                    and gen["proposals"][0][0] == msg.client_sequence_number
+                ):
+                    gen["proposals"].popleft()
             self._check_proposals()
         elif msg.type == MessageType.OPERATION:
             address = msg.contents["address"]
@@ -380,6 +507,21 @@ class ContainerRuntime:
                     f"pending mismatch: {pseq} != {msg.client_sequence_number}"
                 )
                 assert pchan == address
+            elif (gen := self._match_prior_gen(msg)) is not None:
+                # In-flight op from a dropped connection that did get
+                # sequenced: ack it against that generation's saved FIFO —
+                # applying it as remote would duplicate the already-applied
+                # local state.
+                assert gen["pending"], "prior echo with no saved pending"
+                pseq, pchan, pcontents, local_metadata = (
+                    gen["pending"].popleft()
+                )
+                assert pseq == msg.client_sequence_number, (
+                    f"prior pending mismatch: {pseq} != "
+                    f"{msg.client_sequence_number}"
+                )
+                assert pchan == address
+                local = True
             channel = self.channels.get(address)
             if channel is not None:
                 channel.process_core(
@@ -425,6 +567,21 @@ class ContainerRuntime:
         self.connection.disconnect()
         self.connected = False
 
+    def drop_connection(self) -> None:
+        """Ungraceful connection loss (socket drop, idle eviction): unlike
+        disconnect(), in-flight ops may be sequenced-but-unseen. Reconnect
+        resolves their fate: echoes from the dead connection that did get
+        sequenced arrive during catch-up and ack against the saved pending
+        FIFO; once the server's LEAVE for the old client sequences, whatever
+        remains was never sequenced and regenerates through resubmit."""
+        if not self.connected:
+            return
+        self.connected = False
+        try:
+            self.connection.disconnect()
+        except Exception:
+            pass  # the socket is already gone
+
     def reconnect(self) -> None:
         """Rejoin under a new client id, catch up, then regenerate offline
         edits through each channel's resubmit path (reference
@@ -435,6 +592,21 @@ class ContainerRuntime:
         # below would send them raw (stale client id / local seqs), bypassing
         # the per-channel regenerate path.
         self.flush()
+        if self.pending or self._inflight_proposals:
+            # Ungraceful drop left in-flight ops of unknown fate: park them
+            # as a prior generation; catch-up echoes ack them, the old
+            # client's LEAVE resubmits the remainder (_match_prior_gen /
+            # _resolve_prior_connection). Repeated drops stack generations.
+            self._prior_gens.append(
+                {
+                    "client_id": self.client_id,
+                    "join_seq": self._join_seq,
+                    "pending": self.pending,
+                    "proposals": self._inflight_proposals,
+                }
+            )
+            self.pending = deque()
+            self._inflight_proposals = deque()
         self.connection = self._service.connect(
             self.doc_id, self._mode, from_seq=self.ref_seq
         )
@@ -449,39 +621,99 @@ class ContainerRuntime:
         for ch in self.channels.values():
             ch.on_reconnect(self.client_id)
         offline, self._offline = self._offline, []
+        self._offline_folded = 0
         self.process_incoming()  # catch up before rebasing
         self._resend_pending_attaches()
-        for ch in self.channels.values():
-            ch.begin_resubmit()
-        for channel_id, contents, local_metadata in offline:
-            self.channels[channel_id].resubmit_core(contents, local_metadata)
-        for ch in self.channels.values():
-            ch.end_resubmit()
+        if self._prior_gens and offline:
+            # Earlier-authored in-flight ops still await their LEAVEs: park
+            # the offline edits as a synthetic (already-resolved) generation
+            # behind them so resubmission preserves authored order across
+            # connections (the reference's single ordered PendingStateManager
+            # list has this property by construction).
+            self._prior_gens.append(
+                {
+                    "client_id": None,
+                    "join_seq": -1,
+                    "pending": deque(),
+                    "proposals": deque(),
+                    "entries": offline,
+                    "resolved": True,
+                }
+            )
+        else:
+            self._regenerate_through_channels(offline)
         self.flush()
+        proposals, self._offline_proposals = self._offline_proposals, []
+        for key, value in proposals:
+            self.propose(key, value)
+
+    def _resolve_prior_connection(self, gen: dict) -> None:
+        """The server's LEAVE for a dropped connection has sequenced —
+        nothing more from it can arrive, so whatever is still in its saved
+        pending FIFO was never sequenced. Mark it resolved; resubmission
+        happens strictly in generation (authored) order, so a late LEAVE
+        for an older generation is never overtaken by a newer one."""
+        gen["resolved"] = True
+        self._drain_resolved_gens()
+
+    def _drain_resolved_gens(self) -> None:
+        """Resubmit prior generations once EVERY one is resolved, in
+        authored order under ONE resubmit bracket. One bracket matters:
+        each channel snapshots its state once per bracket, so a later op's
+        regenerated position still sees earlier ops at their original local
+        seqs — replaying generation-by-generation would restamp the earlier
+        ops and hide them from the later ones' perspectives. Waiting for
+        all LEAVEs delays resubmission a little; it never loses ops."""
+        if not self._prior_gens or not all(
+            g.get("resolved") for g in self._prior_gens
+        ):
+            return
+        gens, self._prior_gens = self._prior_gens, []
+        to_replay: list = []
+        for gen in gens:
+            # Unsequenced proposals from the dead connection: re-propose (or
+            # buffer for reconnect — propose() handles both states).
+            for _cseq, key, value in gen["proposals"]:
+                self.propose(key, value)
+            to_replay.extend(
+                gen.get("entries")
+                or (
+                    (chan, contents, meta)
+                    for _cseq, chan, contents, meta in gen["pending"]
+                )
+            )
+        if not to_replay:
+            return
+        if not self.connected:
+            # Resolved before reconnect: fold into the offline buffer ahead
+            # of later-authored offline edits but after earlier folds (the
+            # cursor keeps authored order across folds).
+            self._offline[
+                self._offline_folded : self._offline_folded
+            ] = to_replay
+            self._offline_folded += len(to_replay)
+            return
+        # Any unacked ATTACH must re-announce before ops on its channel
+        # regenerate, or remote replicas drop those ops on the floor.
+        self._resend_pending_attaches()
+        self._regenerate_through_channels(to_replay)
 
     def send_noop(self) -> None:
         """Flush our refSeq to the service so the MSN can advance (the
-        reference CollabWindowTracker's periodic noop)."""
-        self.client_seq += 1
-        self.connection.submit(
-            DocumentMessage(
-                client_sequence_number=self.client_seq,
-                reference_sequence_number=self.ref_seq,
-                type=MessageType.NOOP,
-            )
-        )
+        reference CollabWindowTracker's periodic noop). A noop lost to a
+        dead connection needs no recovery — the next connection's join
+        refreshes our refSeq server-side."""
+        self._submit_system(MessageType.NOOP)
 
     def propose(self, key: str, value: Any) -> None:
-        """Submit a quorum proposal (approved once MSN >= its seq)."""
-        self.client_seq += 1
-        self.connection.submit(
-            DocumentMessage(
-                client_sequence_number=self.client_seq,
-                reference_sequence_number=self.ref_seq,
-                type=MessageType.PROPOSE,
-                contents={"key": key, "value": value},
-            )
-        )
+        """Submit a quorum proposal (approved once MSN >= its seq). On a
+        dead connection the proposal buffers and re-submits at reconnect."""
+        if not self._submit_system(
+            MessageType.PROPOSE, {"key": key, "value": value}
+        ):
+            self._offline_proposals.append((key, value))
+        else:
+            self._inflight_proposals.append((self.client_seq, key, value))
 
     def _check_proposals(self) -> None:
         for seq in sorted(self.pending_proposals):
@@ -598,19 +830,16 @@ class ContainerRuntime:
     def submit_summary(self) -> str:
         """Upload the current summary and submit the Summarize op; the
         scribe acks or nacks it on the sequenced stream."""
-        assert not self.pending and not self._outbox, (
+        assert not self._has_unacked_local_state(), (
             "summarize with unacked local ops"
         )
         summary = self.summarize()
         handle = self._service.store.put_summary(summary)
-        self.client_seq += 1
-        self.connection.submit(
-            DocumentMessage(
-                client_sequence_number=self.client_seq,
-                reference_sequence_number=self.ref_seq,
-                type=MessageType.SUMMARIZE,
-                contents={"handle": handle, "head": self.ref_seq},
-            )
+        # A dead connection just means no Summarize op: the uploaded tree is
+        # orphaned (content-addressed, harmless) and the next elected
+        # summarizer retries.
+        self._submit_system(
+            MessageType.SUMMARIZE, {"handle": handle, "head": self.ref_seq}
         )
         return handle
 
@@ -622,12 +851,22 @@ class ContainerRuntime:
 
         return SummarizerElection(self).is_elected
 
+    def _has_unacked_local_state(self) -> bool:
+        """Locally-applied edits not yet sequenced, in any holding area: a
+        summary taken now would bake them in as committed state, and their
+        later resubmission would double-apply them on loaders."""
+        return bool(
+            self.pending
+            or self._outbox
+            or self._offline
+            or self._prior_gens
+        )
+
     def _maybe_auto_summarize(self) -> None:
         if (
             self.summary_interval is not None
             and self.is_summarizer
-            and not self.pending
-            and not self._outbox
+            and not self._has_unacked_local_state()
             # Decline (don't crash op processing) while holding op-attached
             # channels of unknown type: our summary would erase them.
             and not (set(self._unrealized) - set(self._carried_summaries))

@@ -120,6 +120,14 @@ class RemoteMessageProcessor:
         self._batch_remainder: Dict[int, List[Any]] = {}
         self._chunks: Dict[int, List[str]] = {}
 
+    def forget_client(self, client_id: int) -> None:
+        """Purge partial chunk/batch state for a departed client. A client
+        that dies mid-chunked-op leaves a partial accumulator behind; its
+        slot recycles, so the next holder's first chunk would trip the
+        in-order assert against the corpse's state."""
+        self._chunks.pop(client_id, None)
+        self._batch_remainder.pop(client_id, None)
+
     def process(
         self, msg: SequencedDocumentMessage
     ) -> Optional[SequencedDocumentMessage]:

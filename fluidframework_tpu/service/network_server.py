@@ -149,6 +149,11 @@ class FluidNetworkServer:
                 head = wsproto.read_http_head(data)
                 if head is not None:
                     break
+                # No complete header yet: everything buffered IS header
+                # bytes, so cap it (a coalesced body after the blank line
+                # would have parsed above).
+                if len(data) > 64 << 10:
+                    return
             request_line, headers, rest = head
             method, path, _ = request_line.decode().split(" ", 2)
             if headers.get("upgrade", "").lower() == "websocket":
@@ -157,6 +162,8 @@ class FluidNetworkServer:
                 await self._rest(reader, writer, method, path, headers, rest)
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
+        except ValueError:
+            pass  # protocol violation (oversized/malformed frame): drop
         finally:
             try:
                 writer.close()
@@ -166,7 +173,14 @@ class FluidNetworkServer:
     # -- REST (delta storage + blob storage) --------------------------------
 
     async def _rest(self, reader, writer, method, path, headers, body) -> None:
-        need = int(headers.get("content-length", "0")) - len(body)
+        content_length = int(headers.get("content-length", "0"))
+        if content_length > wsproto.MAX_FRAME_BYTES:
+            writer.write(
+                b"HTTP/1.1 413 Payload Too Large\r\n"
+                b"Content-Length: 0\r\nConnection: close\r\n\r\n"
+            )
+            return
+        need = content_length - len(body)
         while need > 0:
             chunk = await reader.read(need)
             if not chunk:

@@ -84,13 +84,22 @@ def encode_frame(opcode: int, payload: bytes, mask: bool = False) -> bytes:
     return bytes(head) + payload
 
 
+# Reference caps the op channel at 16KB messages (routerlicious
+# config.json:55 maxMessageSize); 16MB here leaves room for snapshot blobs
+# while bounding what one peer can make the server buffer.
+MAX_FRAME_BYTES = 16 << 20
+
+
 class FrameDecoder:
     """Incremental decoder: feed bytes, pop (opcode, payload) frames.
-    Continuation frames are merged into their initial frame."""
+    Continuation frames are merged into their initial frame. Declared frame
+    lengths (and the merged message size) are capped at ``max_bytes`` so a
+    hostile peer cannot make us buffer unboundedly."""
 
-    def __init__(self) -> None:
+    def __init__(self, max_bytes: int = MAX_FRAME_BYTES) -> None:
         self._buf = bytearray()
         self._partial: Optional[Tuple[int, bytearray]] = None
+        self.max_bytes = max_bytes
 
     def feed(self, data: bytes) -> List[Tuple[int, bytes]]:
         self._buf += data
@@ -98,11 +107,18 @@ class FrameDecoder:
         while True:
             frame = self._try_parse()
             if frame is None:
+                # Cap the UNPARSEABLE remainder only — a full legal frame
+                # plus the coalesced start of the next one may transiently
+                # exceed max_bytes before the drain above consumes it.
+                if len(self._buf) > self.max_bytes + 14:  # payload + header
+                    raise ValueError("frame buffer overflow")
                 return out
             fin, opcode, payload = frame
             if opcode == OP_CONT:
                 if self._partial is None:
                     raise ValueError("continuation without initial frame")
+                if len(self._partial[1]) + len(payload) > self.max_bytes:
+                    raise ValueError("fragmented message exceeds cap")
                 self._partial[1].extend(payload)
                 if fin:
                     op0, acc = self._partial
@@ -132,6 +148,8 @@ class FrameDecoder:
                 return None
             n = struct.unpack_from(">Q", buf, pos)[0]
             pos += 8
+        if n > self.max_bytes:
+            raise ValueError(f"declared frame length {n} exceeds cap")
         key = None
         if masked:
             if len(buf) < pos + 4:

@@ -434,3 +434,18 @@ def test_property_array_fuzz(seed):
     drain(rts)
     vals = [d.get("arr") for d in docs]
     assert vals[0] == vals[1] == vals[2]
+
+
+def test_squash_remove_reinsert_keeps_array_ops():
+    # ADVICE r1: a single changeset with remove[p] + insert[p] + arrays[p]
+    # (remove, reinsert, then edit the new array) must keep its own array
+    # ops under the compose law apply(doc, squash(a,b)) == apply(apply(doc,a), b).
+    a = {"insert": {"p": ("Array", [1, 2, 3])}, "modify": {}, "remove": [],
+         "arrays": {}}
+    b = {"insert": {"p": ("Array", [])}, "modify": {}, "remove": ["p"],
+         "arrays": {"p": [{"i": 0, "ins": [9]}]}}
+    d1, d2 = {}, {}
+    apply_changeset(d1, squash(a, b))
+    apply_changeset(d2, a)
+    apply_changeset(d2, b)
+    assert d1 == d2 == {"p": ("Array", [9])}

@@ -121,3 +121,35 @@ def test_debugger_steps_not_lost_to_partial_release():
     b.process_incoming(1)
     b.process_incoming(1)  # second step must still be available
     assert b.get_channel("t").get_text() == "xy"
+
+
+def test_cache_hostile_handles_stay_inside_cache_dir(tmp_path):
+    # ADVICE r1: server-supplied handles/doc ids must never become raw
+    # filenames — '../x' would escape the cache directory.
+    cache = PersistentCache(str(tmp_path / "cache"))
+    evil = "../../escape"
+    cache.put_blob(evil, b"payload")
+    assert cache.get_blob(evil) == b"payload"
+    assert cache.has_blob(evil)
+    cache.put_doc("../esc-doc", {"epoch": 1, "head": 0, "ops": [],
+                                 "summary": None})
+    assert cache.get_doc("../esc-doc") is not None
+    assert not (tmp_path.parent / "escape").exists()
+    assert not (tmp_path / "escape").exists()
+    # Everything written landed under the cache root.
+    outside = [
+        p for p in tmp_path.rglob("*") if p.is_file()
+        and "cache" not in p.parts[len(tmp_path.parts):][0:1]
+    ]
+    assert outside == []
+
+
+def test_cache_disk_roundtrip_with_hashed_names(tmp_path):
+    d = str(tmp_path / "c")
+    cache = PersistentCache(d)
+    cache.put_blob("sha-abc", b"hello")
+    cache.put_doc("doc1", {"epoch": 1, "head": 3, "ops": [], "summary": None})
+    # A fresh instance must find both via the hashed on-disk names.
+    fresh = PersistentCache(d)
+    assert fresh.get_blob("sha-abc") == b"hello"
+    assert fresh.get_doc("doc1")["head"] == 3

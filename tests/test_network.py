@@ -182,3 +182,36 @@ def test_url_factory_roundtrip(server):
     conn = ds.connect()
     assert conn.client_id >= 0
     conn.disconnect()
+
+
+def test_frame_decoder_rejects_oversized_declared_length():
+    # ADVICE r1: a hostile peer declaring a huge 64-bit frame length must
+    # not make the server buffer unboundedly.
+    from fluidframework_tpu.service import wsproto
+
+    dec = wsproto.FrameDecoder(max_bytes=1024)
+    header = bytes([0x82, 127]) + (1 << 40).to_bytes(8, "big")
+    with pytest.raises(ValueError):
+        dec.feed(header)
+
+
+def test_frame_decoder_rejects_oversized_fragmented_message():
+    from fluidframework_tpu.service import wsproto
+
+    dec = wsproto.FrameDecoder(max_bytes=256)
+    first = wsproto.encode_frame(wsproto.OP_BINARY, b"x" * 200)
+    # Strip FIN to make it a fragment start.
+    first = bytes([first[0] & 0x7F]) + first[1:]
+    dec.feed(first)
+    cont = wsproto.encode_frame(wsproto.OP_CONT, b"y" * 200)
+    cont_nofin = bytes([cont[0] & 0x7F]) + cont[1:]
+    with pytest.raises(ValueError):
+        dec.feed(cont_nofin)
+
+
+def test_frame_decoder_accepts_normal_traffic_under_cap():
+    from fluidframework_tpu.service import wsproto
+
+    dec = wsproto.FrameDecoder(max_bytes=1024)
+    frames = dec.feed(wsproto.encode_frame(wsproto.OP_TEXT, b"hello", mask=True))
+    assert frames == [(wsproto.OP_TEXT, b"hello")]
