@@ -63,6 +63,75 @@ def cpu_oracle_baseline(ops_one_doc: np.ndarray) -> float:
     return n / (time.perf_counter() - t0)
 
 
+def device_state_parity(on_tpu: bool) -> dict:
+    """Kernel-vs-oracle state equality ON THE LIVE DEVICE (VERDICT r1 #2).
+
+    The CPU test suite pins semantics in interpret mode; this runs the real
+    compiled Pallas kernels on the benchmark chip — where compiler and
+    precision behavior can differ (the MXU permutation transport in
+    pallas_compact relies on precision=HIGHEST int-exactness) — and
+    compares materialized documents byte-for-byte against the pure-Python
+    oracle, including a mid-stream compaction round over real tombstones
+    (msn advances behind the stream).
+    """
+    from fluidframework_tpu.ops.pallas_compact import compact_packed
+    from fluidframework_tpu.ops.pallas_kernel import (
+        apply_ops_packed,
+        pack_state,
+        unpack_state,
+    )
+    from fluidframework_tpu.ops.segment_state import (
+        SegmentState,
+        make_batched_state,
+        materialize,
+    )
+    from fluidframework_tpu.protocol.constants import NO_CLIENT
+    from fluidframework_tpu.testing.fuzz import random_acked_stream
+    from fluidframework_tpu.testing.oracle import OracleDoc
+
+    n_docs, n_ops, capacity = 8, 96, 256
+    payloads: dict = {}
+    oracles = [OracleDoc(NO_CLIENT) for _ in range(n_docs)]
+    streams = [
+        np.stack(
+            random_acked_stream(
+                np.random.default_rng(1000 + d), n_ops, payloads,
+                oracles[d], msn_lag=24, caught_up=True,
+            )
+        )
+        for d in range(n_docs)
+    ]
+    batch = np.stack(streams).astype(np.int32)
+    tables, scalars = pack_state(
+        make_batched_state(n_docs, capacity, NO_CLIENT)
+    )
+    # Two halves with a compaction between: parity must survive zamboni.
+    half = n_ops // 2
+    tables, scalars = apply_ops_packed(
+        tables, scalars, batch[:, :half], block_docs=n_docs,
+        interpret=not on_tpu,
+    )
+    tables, scalars = compact_packed(tables, scalars, interpret=not on_tpu)
+    tables, scalars = apply_ops_packed(
+        tables, scalars, batch[:, half:], block_docs=n_docs,
+        interpret=not on_tpu,
+    )
+    tables, scalars = compact_packed(tables, scalars, interpret=not on_tpu)
+    state = unpack_state(tables, scalars)
+    host = SegmentState(*[np.asarray(x) for x in state])
+    mismatches = 0
+    for d in range(n_docs):
+        one = SegmentState(*[np.asarray(x)[d] for x in host])
+        if materialize(one, payloads) != oracles[d].text(payloads):
+            mismatches += 1
+    errs = int(np.sum(host.err != 0))
+    assert mismatches == 0 and errs == 0, (
+        f"on-device state parity FAILED: {mismatches} mismatched docs, "
+        f"{errs} error flags"
+    )
+    return {"state_parity_docs": n_docs, "state_parity": "ok"}
+
+
 def main() -> None:
     import jax
 
@@ -116,6 +185,7 @@ def main() -> None:
     state = unpack_state(tables, scalars)
     errs = int(np.sum(np.asarray(state.err) != 0))
     baseline = cpu_oracle_baseline(host_ops[0])
+    parity = device_state_parity(on_tpu)
 
     print(
         json.dumps(
@@ -130,6 +200,7 @@ def main() -> None:
                 "docs_with_errors": errs,
                 "cpu_oracle_ops_per_sec": round(baseline),
                 "device": str(jax.devices()[0]),
+                **parity,
             }
         )
     )

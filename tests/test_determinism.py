@@ -18,7 +18,8 @@ from fluidframework_tpu.ops.segment_state import SegmentState, make_batched_stat
 from fluidframework_tpu.protocol.constants import NO_CLIENT
 from fluidframework_tpu.testing.oracle import OracleDoc
 
-from test_pallas_kernel import assert_states_equal, random_acked_stream
+from test_pallas_kernel import assert_states_equal
+from fluidframework_tpu.testing.fuzz import random_acked_stream
 
 
 def _stream(seed, n_ops=48):

@@ -25,6 +25,7 @@ from fluidframework_tpu.protocol.constants import (
     OP_WIDTH,
     UNASSIGNED_SEQ,
 )
+from fluidframework_tpu.testing.fuzz import random_acked_stream
 from fluidframework_tpu.testing.oracle import OracleDoc
 
 
@@ -35,36 +36,6 @@ def assert_states_equal(a: SegmentState, b: SegmentState):
         )
 
 
-def random_acked_stream(rng, n_ops, payloads, track: OracleDoc):
-    """Valid fully-acked sequenced ops, evolving alongside an oracle."""
-    ops = []
-    next_orig = len(payloads) + 1
-    for seq in range(1, n_ops + 1):
-        length = len(track.text(payloads))
-        kind = int(rng.integers(0, 3)) if length > 0 else 0
-        client = int(rng.integers(0, 6))
-        if kind == 0:
-            n = int(rng.integers(1, 6))
-            payloads[next_orig] = "x" * n
-            op = E.insert(
-                int(rng.integers(0, length + 1)), next_orig, n,
-                seq=seq, ref=int(rng.integers(0, seq)), client=client,
-            )
-            next_orig += 1
-        elif kind == 1:
-            a = int(rng.integers(0, length))
-            b = int(rng.integers(a + 1, length + 1))
-            op = E.remove(a, b, seq=seq, ref=seq - 1, client=client)
-        else:
-            a = int(rng.integers(0, length))
-            b = int(rng.integers(a + 1, length + 1))
-            op = E.annotate(
-                a, b, int(rng.integers(1, 100)), seq=seq, ref=seq - 1,
-                client=client,
-            )
-        ops.append(op)
-        track.apply(op)
-    return ops
 
 
 @pytest.mark.parametrize("seed", range(6))
