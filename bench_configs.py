@@ -235,9 +235,20 @@ def config4_matrix_axis_merge(n_docs: int, k: int, on_tpu: bool) -> None:
 
 
 def config5_deli_scribe_e2e(n_docs: int, ops_per_doc: int, on_tpu: bool) -> None:
-    """Host sequencing through deli (partitioned pipeline semantics) with
-    the sequenced batches applied as device kernel ops — the end-to-end
-    service shape (TpuDeliLambda)."""
+    """Honest end-to-end service shape (TpuDeliLambda + scribe):
+
+    - EVERY document runs its own real deli ticket loop — no script tiling
+      (the host sequencing cost of the whole fleet is the number being
+      measured; reference deli/lambda.ts:742);
+    - a scribe stage writes logTail service summaries for a rotating slice
+      of the fleet into the summary store INSIDE the timed loop (reference
+      scribe/lambda.ts:106,304);
+    - double-buffered boxcars: round r+1's host sequencing and the scribe
+      writes overlap the device's round r (async dispatch; the err-lane
+      readback is the barrier — SURVEY §7 hard part f);
+    - device-only step time is measured separately on a pre-staged chain,
+      so the dev tunnel's dispatch round-trip is amortized out.
+    """
     import jax
 
     from fluidframework_tpu.ops.pallas_compact import compact_packed
@@ -251,19 +262,24 @@ def config5_deli_scribe_e2e(n_docs: int, ops_per_doc: int, on_tpu: bool) -> None
     from fluidframework_tpu.protocol.constants import NO_CLIENT, OP_WIDTH
     from fluidframework_tpu.protocol.types import DocumentMessage, MessageType
     from fluidframework_tpu.service.sequencer import DocumentSequencer
+    from fluidframework_tpu.service.summary_store import SummaryStore
 
     rng = np.random.default_rng(0)
-    scripts = min(n_docs, 16)
-    sequencers = [DocumentSequencer(f"doc{d}") for d in range(scripts)]
+    rounds = 3
+    sequencers = [DocumentSequencer(f"doc{d}") for d in range(n_docs)]
     clients = [s.join().contents["clientId"] for s in sequencers]
-    lengths = [0] * scripts
+    lengths = [0] * n_docs
+    store = SummaryStore()
+    summary_writes = 0
 
     def sequence_round() -> np.ndarray:
-        """Host stage: per-doc deli ticket loops (16 scripts, tiled). Each
-        round closes with a whole-doc remove + window advance so the device
+        """Host stage: one real deli ticket loop per document. Each round
+        closes with a whole-doc remove + window advance so the device
         tables stay bounded (steady state)."""
         batches = np.zeros((n_docs, ops_per_doc, OP_WIDTH), np.int32)
-        for d in range(scripts):
+        rolls = rng.random((n_docs, ops_per_doc))
+        pos_rolls = rng.random((n_docs, ops_per_doc))  # uniform positions
+        for d in range(n_docs):
             seqr, client = sequencers[d], clients[d]
             for i in range(ops_per_doc):
                 msg = seqr.ticket(
@@ -277,14 +293,13 @@ def config5_deli_scribe_e2e(n_docs: int, ops_per_doc: int, on_tpu: bool) -> None
                     ),
                 )
                 s = msg.sequence_number
-                last = i == ops_per_doc - 1
-                if last:
+                if i == ops_per_doc - 1:
                     batches[d, i] = E.remove(
                         0, lengths[d], seq=s, ref=s - 1, client=client, msn=s
                     )
                     lengths[d] = 0
-                elif lengths[d] >= 6 and rng.random() < 0.4:
-                    a = int(rng.integers(0, lengths[d] - 2))
+                elif lengths[d] >= 6 and rolls[d, i] < 0.4:
+                    a = int(pos_rolls[d, i] * (lengths[d] - 2))
                     batches[d, i] = E.remove(
                         a, a + 2, seq=s, ref=s - 1, client=client,
                         msn=msg.minimum_sequence_number,
@@ -292,14 +307,26 @@ def config5_deli_scribe_e2e(n_docs: int, ops_per_doc: int, on_tpu: bool) -> None
                     lengths[d] -= 2
                 else:
                     batches[d, i] = E.insert(
-                        int(rng.integers(0, lengths[d] + 1)), 10 + i, 3,
+                        int(pos_rolls[d, i] * (lengths[d] + 1)), 10 + i, 3,
                         seq=s, ref=s - 1, client=client,
                         msn=msg.minimum_sequence_number,
                     )
                     lengths[d] += 3
-        for d in range(scripts, n_docs):
-            batches[d] = batches[d % scripts]
         return batches
+
+    def scribe_round(r: int, batches: np.ndarray) -> int:
+        """Service-summary stage: persist the logTail (this round's
+        sequenced rows) for the 1/rounds slice of docs due this round."""
+        n = 0
+        for d in range(r, n_docs, rounds):
+            store.put_blob(
+                json.dumps(
+                    {"doc": f"doc{d}", "head": int(sequencers[d].seq)}
+                ).encode()
+                + batches[d].tobytes()
+            )
+            n += 1
+        return n
 
     tables, scalars = pack_state(make_batched_state(n_docs, 128, NO_CLIENT))
     blk = 32 if on_tpu else 8
@@ -313,13 +340,13 @@ def config5_deli_scribe_e2e(n_docs: int, ops_per_doc: int, on_tpu: bool) -> None
         "warmup round must be clean — errs below count timed rounds only"
     )
 
-    rounds = 3
     t0 = time.perf_counter()
-    t_host = 0.0
-    for _ in range(rounds):
-        th = time.perf_counter()
-        batch = sequence_round()
-        t_host += time.perf_counter() - th
+    t_seq = 0.0  # deli ticket loops only
+    t_scribe = 0.0  # summary writes only
+    th = time.perf_counter()
+    batch = sequence_round()  # round 0's boxcar
+    t_seq += time.perf_counter() - th
+    for r in range(rounds):
         jops = jax.device_put(batch)
         tables, scalars = apply_ops_packed(
             tables, scalars, jops, block_docs=blk, interpret=not on_tpu
@@ -327,13 +354,42 @@ def config5_deli_scribe_e2e(n_docs: int, ops_per_doc: int, on_tpu: bool) -> None
         tables, scalars = compact_packed(
             tables, scalars, interpret=not on_tpu
         )
-        errs = int(np.asarray(scalars[:, SC_ERR]).sum())
+        # Overlap window: while the device chews round r, the host runs the
+        # scribe stage and stages round r+1 (double-buffered boxcar).
+        th = time.perf_counter()
+        summary_writes += scribe_round(r, batch)
+        t_scribe += time.perf_counter() - th
+        if r + 1 < rounds:
+            th = time.perf_counter()
+            batch = sequence_round()
+            t_seq += time.perf_counter() - th
+        errs = int(np.asarray(scalars[:, SC_ERR]).sum())  # barrier
     dt = time.perf_counter() - t0
+
+    # Device-only step time: a pre-staged chain of steps with ONE readback
+    # at the end — dispatch/tunnel overhead amortizes out. Seq stamps in the
+    # replayed batch repeat, which is harmless for the apply cost.
+    chain = 10
+    td = time.perf_counter()
+    for _ in range(chain):
+        tables, scalars = apply_ops_packed(
+            tables, scalars, jops, block_docs=blk, interpret=not on_tpu
+        )
+        tables, scalars = compact_packed(
+            tables, scalars, interpret=not on_tpu
+        )
+    np.asarray(scalars[:, SC_ERR])
+    device_step_ms = (time.perf_counter() - td) / chain * 1e3
+
     total = n_docs * ops_per_doc * rounds
     _emit(
-        metric="deli_to_device_e2e_ops_per_sec", value=round(total / dt),
-        unit="ops/s", config=5, n_docs=n_docs,
-        host_stage_s=round(t_host, 3), errs=errs,
+        metric="deli_scribe_e2e_ops_per_sec", value=round(total / dt),
+        unit="ops/s", config=5, n_docs=n_docs, host_docs=n_docs,
+        host_stage_s=round(t_seq + t_scribe, 3),
+        host_seq_s=round(t_seq, 3), scribe_s=round(t_scribe, 3),
+        host_tickets_per_sec=round(total / t_seq),
+        summary_writes=summary_writes,
+        device_step_ms=round(device_step_ms, 3), errs=errs,
     )
 
 
