@@ -29,6 +29,7 @@ import itertools
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from fluidframework_tpu.ops.segment_state import removed_by_slot_host
 from fluidframework_tpu.protocol.constants import (
     KIND_FREE,
     RSEQ_NONE,
@@ -56,7 +57,10 @@ def _visible_len(h, i: int, *, ref_seq: Optional[int], client: int) -> int:
     if not ins_ok:
         return 0
     rseq = int(h.rseq[i])
-    removed = (client >= 0 and (int(h.rbits[i]) >> client) & 1) or (
+    by_client = client >= 0 and removed_by_slot_host(
+        int(h.rbits[i]), int(h.rbits2[i]), client
+    )
+    removed = by_client or (
         rseq not in (RSEQ_NONE, UNASSIGNED_SEQ) and rseq <= ref_seq
     )
     return 0 if removed else int(h.length[i])

@@ -434,7 +434,7 @@ class SharedString(SharedObject):
         return {
             "lanes": {k: np.asarray(getattr(h, k))[:n].tolist() for k in (
                 "kind", "orig", "off", "length", "seq", "client", "lseq",
-                "rseq", "rlseq", "rbits", "aseq", "alseq", "aval",
+                "rseq", "rlseq", "rbits", "rbits2", "aseq", "alseq", "aval",
             )},
             "count": n,
             "min_seq": int(h.min_seq),

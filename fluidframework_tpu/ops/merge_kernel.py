@@ -44,7 +44,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from fluidframework_tpu.ops.segment_state import SEGMENT_LANES, SegmentState
+from fluidframework_tpu.ops.segment_state import (
+    SEGMENT_LANES,
+    SegmentState,
+    removed_by_slot,
+    writer_bits,
+)
 from fluidframework_tpu.protocol.constants import (
     ERR_CAPACITY,
     ERR_CLIENT,
@@ -111,7 +116,7 @@ def perspective(state: SegmentState, ref_seq, client, is_local):
     # a pending local remove never hides a row from a remote op's view,
     # and a pending local insert is invisible unless client-matched.
     rseq_eff = jnp.where(state.rseq == UNASSIGNED_SEQ, RSEQ_NONE, state.rseq)
-    removed_by_client = ((state.rbits >> jnp.clip(client, 0, 31)) & 1) == 1
+    removed_by_client = removed_by_slot(state.rbits, state.rbits2, client)
     hidden = removed & ((rseq_eff <= ref_seq) | removed_by_client)
     seq_eff = jnp.where(
         state.seq == UNASSIGNED_SEQ, NORM_EXISTING_LOCAL, state.seq
@@ -216,6 +221,7 @@ def _apply_insert(state: SegmentState, op: jnp.ndarray) -> SegmentState:
         rseq=z + RSEQ_NONE,
         rlseq=z,
         rbits=z,
+        rbits2=z,
         aseq=z,
         alseq=z,
         aval=z,
@@ -295,7 +301,7 @@ def _apply_remove(state: SegmentState, op: jnp.ndarray) -> SegmentState:
     )
 
     local_op = op[F_SEQ] == UNASSIGNED_SEQ
-    bit = (jnp.int32(1) << jnp.clip(op[F_CLIENT], 0, 31)).astype(_I32)
+    bit_lo, bit_hi = writer_bits(op[F_CLIENT])
     not_removed = state.rseq == RSEQ_NONE
     was_local = state.rseq == UNASSIGNED_SEQ
 
@@ -306,7 +312,8 @@ def _apply_remove(state: SegmentState, op: jnp.ndarray) -> SegmentState:
         cov,
         rseq=new_rseq,
         rlseq=new_rlseq,
-        rbits=state.rbits | bit,
+        rbits=state.rbits | bit_lo,
+        rbits2=state.rbits2 | bit_hi,
     )
     return _bookkeep(state, op)
 

@@ -37,7 +37,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from fluidframework_tpu.ops.segment_state import SEGMENT_LANES, SegmentState
+from fluidframework_tpu.ops.segment_state import (
+    SEGMENT_LANES,
+    SegmentState,
+    removed_by_slot,
+    writer_bits,
+)
 from fluidframework_tpu.protocol.constants import (
     ERR_CAPACITY,
     ERR_CLIENT,
@@ -115,7 +120,7 @@ def _kernel(ops_ref, tables_ref, scalars_ref, otables_ref, oscalars_ref):
     def step(k, carry):
         lanes, count, min_seq, cur_seq, self_client, err = carry
         (kind, orig, off, length, seq, client, lseq, rseq, rlseq, rbits,
-         aseq, alseq, aval) = lanes
+         rbits2, aseq, alseq, aval) = lanes
 
         op = jnp.reshape(ops_ref[pl.ds(k, 1), :, :], (b, OP_WIDTH))
 
@@ -133,16 +138,15 @@ def _kernel(ops_ref, tables_ref, scalars_ref, otables_ref, oscalars_ref):
         is_range = is_rem | is_ann
         local_op = seqn == UNASSIGNED_SEQ
         is_local = clientn == self_client
-        cshift = jnp.clip(clientn, 0, 31)
 
         # -- perspective (merge_kernel.perspective, mergeTree.ts:916-1004) --
-        def perspective(kind_, seq_, client_, length_, rseq_, rbits_):
+        def perspective(kind_, seq_, client_, length_, rseq_, rbits_, rbits2_):
             live = kind_ != KIND_FREE
             removed = rseq_ != RSEQ_NONE
             r_acked = removed & (rseq_ != UNASSIGNED_SEQ)
             skip = r_acked & (rseq_ <= min_seq)
             rseq_eff = jnp.where(rseq_ == UNASSIGNED_SEQ, RSEQ_NONE, rseq_)
-            removed_by_client = ((rbits_ >> cshift) & 1) == 1
+            removed_by_client = removed_by_slot(rbits_, rbits2_, clientn)
             hidden = removed & ((rseq_eff <= refn) | removed_by_client)
             seq_eff = jnp.where(seq_ == UNASSIGNED_SEQ, NORM_EXISTING_LOCAL, seq_)
             ins_vis = (client_ == clientn) | (seq_eff <= refn)
@@ -152,7 +156,8 @@ def _kernel(ops_ref, tables_ref, scalars_ref, otables_ref, oscalars_ref):
             part = live & ~skip
             return part, jnp.where(part, vis, 0)
 
-        part, vis = perspective(kind, seq, client, length, rseq, rbits)
+        part, vis = perspective(kind, seq, client, length, rseq, rbits,
+                                rbits2)
         prefix = _excl_cumsum(vis)
         total = jnp.sum(vis, axis=1, keepdims=True)
         rem1 = pos1 - prefix
@@ -195,7 +200,7 @@ def _kernel(ops_ref, tables_ref, scalars_ref, otables_ref, oscalars_ref):
         )
 
         lanes = [kind, orig, off, length, seq, client, lseq, rseq, rlseq,
-                 rbits, aseq, alseq, aval]
+                 rbits, rbits2, aseq, alseq, aval]
         I_OFF, I_LEN = 2, 3
 
         # -- split A at pos1 (insert mid-segment or range start) -----------
@@ -233,6 +238,7 @@ def _kernel(ops_ref, tables_ref, scalars_ref, otables_ref, oscalars_ref):
             jnp.full((b, s), RSEQ_NONE, _I32),  # rseq
             jnp.zeros((b, s), _I32),  # rlseq
             jnp.zeros((b, s), _I32),  # rbits
+            jnp.zeros((b, s), _I32),  # rbits2
             jnp.zeros((b, s), _I32),  # aseq
             jnp.zeros((b, s), _I32),  # alseq
             jnp.zeros((b, s), _I32),  # aval
@@ -246,10 +252,11 @@ def _kernel(ops_ref, tables_ref, scalars_ref, otables_ref, oscalars_ref):
         )
 
         (kind, orig, off, length, seq, client, lseq, rseq, rlseq, rbits,
-         aseq, alseq, aval) = lanes
+         rbits2, aseq, alseq, aval) = lanes
 
         # -- covered rows (post-split perspective; _covered/nodeMap) -------
-        part2, vis2 = perspective(kind, seq, client, length, rseq, rbits)
+        part2, vis2 = perspective(kind, seq, client, length, rseq, rbits,
+                                  rbits2)
         prefix2 = _excl_cumsum(vis2)
         cov = (
             part2
@@ -262,14 +269,15 @@ def _kernel(ops_ref, tables_ref, scalars_ref, otables_ref, oscalars_ref):
         m_rem = cov & is_rem
         not_removed = rseq == RSEQ_NONE
         was_local = rseq == UNASSIGNED_SEQ
-        bit = (jnp.int32(1) << cshift).astype(_I32)
+        bit_lo, bit_hi = writer_bits(clientn)
         rseq = jnp.where(
             m_rem & (not_removed | was_local), jnp.broadcast_to(seqn, (b, s)), rseq
         )
         rlseq = jnp.where(
             m_rem & not_removed & local_op, jnp.broadcast_to(lseqn, (b, s)), rlseq
         )
-        rbits = jnp.where(m_rem, rbits | bit, rbits)
+        rbits = jnp.where(m_rem, rbits | bit_lo, rbits)
+        rbits2 = jnp.where(m_rem, rbits2 | bit_hi, rbits2)
 
         # -- annotate marks (annotateRange; single-lane LWW) ---------------
         pending = alseq != 0
@@ -303,7 +311,7 @@ def _kernel(ops_ref, tables_ref, scalars_ref, otables_ref, oscalars_ref):
         min_seq = jnp.maximum(min_seq, msn)
 
         lanes = [kind, orig, off, length, seq, client, lseq, rseq, rlseq,
-                 rbits, aseq, alseq, aval]
+                 rbits, rbits2, aseq, alseq, aval]
         return lanes, count, min_seq, cur_seq, self_client, err
 
     lanes0 = [tables_ref[i] for i in range(N_LANES)]

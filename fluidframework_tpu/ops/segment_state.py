@@ -41,7 +41,8 @@ class SegmentState(NamedTuple):
     lseq: jnp.ndarray  # local seq of pending insert (0 = none)
     rseq: jnp.ndarray  # removedSeq (RSEQ_NONE = not removed, UNASSIGNED_SEQ = local)
     rlseq: jnp.ndarray  # local seq of pending remove (0 = none)
-    rbits: jnp.ndarray  # bitmask of removing client slots (removedClientIds)
+    rbits: jnp.ndarray  # bitmask of removing client slots 0-30 (removedClientIds)
+    rbits2: jnp.ndarray  # bitmask of removing client slots 31-61
     aseq: jnp.ndarray  # seq of last annotate (0 = never)
     alseq: jnp.ndarray  # local seq of pending annotate (0 = none)
     aval: jnp.ndarray  # interned annotate value
@@ -64,6 +65,7 @@ SEGMENT_LANES = (
     "rseq",
     "rlseq",
     "rbits",
+    "rbits2",
     "aseq",
     "alseq",
     "aval",
@@ -119,6 +121,7 @@ def make_state(capacity: int, self_client: int, min_seq: int = 0) -> SegmentStat
         rseq=jnp.full((capacity,), RSEQ_NONE, jnp.int32),
         rlseq=z(),
         rbits=z(),
+        rbits2=z(),
         aseq=z(),
         alseq=z(),
         aval=z(),
@@ -159,6 +162,34 @@ def grow(state: SegmentState, new_capacity: int) -> SegmentState:
     )
 
 
+def removed_by_slot(rbits, rbits2, client):
+    """Whether the writer slot appears in the two-lane removers bitmask.
+    Pure jnp (broadcastable) — shared by the XLA and Pallas perspectives;
+    host code can pass plain ints through jnp and cast the result."""
+    lo = ((rbits >> jnp.clip(client, 0, 30)) & 1) == 1
+    hi = ((rbits2 >> jnp.clip(client - 31, 0, 30)) & 1) == 1
+    return jnp.where(client < 31, lo, hi)
+
+
+def removed_by_slot_host(rbits: int, rbits2: int, client: int) -> bool:
+    """Host-int twin of removed_by_slot for per-row Python loops (a jnp
+    call per row would cost a device dispatch each). Same slot layout —
+    keep the two in this module so the mapping has one home."""
+    if client < 31:
+        return bool((rbits >> client) & 1)
+    return bool((rbits2 >> (client - 31)) & 1)
+
+
+def writer_bits(slot):
+    """(lo, hi) single-bit masks for a writer slot: slots 0-30 set a bit in
+    the ``rbits`` lane, 31-61 in ``rbits2`` (31 usable bits per int32 lane
+    keeps the sign bit out of shift arithmetic)."""
+    s = jnp.asarray(slot, jnp.int32)
+    lo = jnp.where(s < 31, jnp.int32(1) << jnp.clip(s, 0, 30), 0)
+    hi = jnp.where(s >= 31, jnp.int32(1) << jnp.clip(s - 31, 0, 30), 0)
+    return lo.astype(jnp.int32), hi.astype(jnp.int32)
+
+
 def adopt_client_slot(state: SegmentState, new_client_id: int) -> SegmentState:
     """Adopt a new connection's client slot after reconnect.
 
@@ -174,12 +205,15 @@ def adopt_client_slot(state: SegmentState, new_client_id: int) -> SegmentState:
 
     pending_ins = state.seq == UNASSIGNED_SEQ
     pending_rem = state.rlseq > 0
-    old_bit = jnp.int32(1) << jnp.clip(state.self_client, 0, 31)
-    new_bit = jnp.int32(1) << jnp.clip(jnp.int32(new_client_id), 0, 31)
+    old_lo, old_hi = writer_bits(state.self_client)
+    new_lo, new_hi = writer_bits(jnp.int32(new_client_id))
     return state._replace(
         client=jnp.where(pending_ins, new_client_id, state.client),
         rbits=jnp.where(
-            pending_rem, (state.rbits & ~old_bit) | new_bit, state.rbits
+            pending_rem, (state.rbits & ~old_lo) | new_lo, state.rbits
+        ),
+        rbits2=jnp.where(
+            pending_rem, (state.rbits2 & ~old_hi) | new_hi, state.rbits2
         ),
         self_client=jnp.int32(new_client_id),
     )

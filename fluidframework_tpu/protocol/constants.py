@@ -48,10 +48,13 @@ F_LEN = 8  # insert length
 F_MSN = 9  # minimum sequence number rider (advances the collab window)
 OP_WIDTH = 10
 
-# Cap on concurrent writers per document: remover sets are stored as an int32
-# bitmask (one bit per client slot). The reference stores removedClientIds as
-# a list (mergeTreeNodes.ts); a 31-slot mask is the round-1 vectorized form.
-MAX_WRITERS = 31
+# Cap on concurrent writers per document: remover sets are stored as TWO
+# int32 bitmask lanes (rbits: slots 0-30, rbits2: slots 31-61; 31 usable
+# bits per lane keeps the sign bit out of the arithmetic). The reference
+# stores removedClientIds as a list (mergeTreeNodes.ts) with a 1M-client
+# config cap; 62 *concurrent* writers per document with slot recycling
+# (service/sequencer.py) covers the same sessions over time.
+MAX_WRITERS = 62
 
 # Error flag bits in SegmentState.err.
 ERR_CAPACITY = 1  # segment table full; op dropped

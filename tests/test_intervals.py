@@ -279,3 +279,27 @@ def test_interval_searches():
     a.flush()
     a.process_incoming()
     assert col.previous_interval(0) == i1
+
+
+def test_interval_anchor_sees_hi_lane_removers():
+    """A remover in writer slot >= 31 (second removers lane) must hide the
+    removed rows from its own perspective in interval anchoring, exactly as
+    the kernel's visibility does (regression: two-lane mask widening)."""
+    import numpy as np
+
+    from fluidframework_tpu.models.interval_collection import anchor_from_pos
+    from fluidframework_tpu.ops import encode as E
+    from fluidframework_tpu.ops.merge_kernel import jit_apply_ops
+    from fluidframework_tpu.ops.segment_state import make_state, to_host
+    from fluidframework_tpu.protocol.constants import NO_CLIENT
+
+    rows = [
+        E.insert(0, 1, 6, seq=1, ref=0, client=40),  # "abcdef"
+        E.remove(1, 3, seq=2, ref=1, client=33),  # hi-lane remover
+    ]
+    st = jit_apply_ops(make_state(32, NO_CLIENT), np.stack(rows).astype(np.int32))
+    h = to_host(st)
+    # From remover 33's perspective the text is "adef": position 1 anchors
+    # to the character 'd' (orig 1, offset 3).
+    anchor = anchor_from_pos(h, 1, ref_seq=2, client=33)
+    assert anchor == (1, 3), anchor

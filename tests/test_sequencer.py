@@ -124,3 +124,33 @@ def test_checkpoint_resume():
     m = s2.ticket(c0, op(2, 2))
     assert m.sequence_number == s.seq + 1
     assert s2.ticket(c0, op(2, 2)) is None  # dedup state survived
+
+
+def test_62_concurrent_writers_then_clean_429_and_retry():
+    """MAX_WRITERS=62 concurrent write slots (two removers-bitmask lanes);
+    the 63rd writer gets a clean 429 nack and can retry once a departed
+    writer's slot ages past the MSN."""
+    from fluidframework_tpu.protocol.constants import MAX_WRITERS
+
+    s = DocumentSequencer("d")
+    clients = []
+    for _ in range(MAX_WRITERS):
+        j = s.join()
+        assert j.type == MessageType.CLIENT_JOIN
+        clients.append(j.contents["clientId"])
+    assert sorted(clients) == list(range(62))
+    overflow = s.join()
+    assert isinstance(overflow, NackMessage)
+    assert overflow.content_code == 429
+    # One writer leaves; its slot recycles only after the MSN passes the
+    # leave (everyone has seen it) — then the retry succeeds.
+    leave = s.leave(clients[5])
+    assert leave is not None
+    still = s.join()
+    assert isinstance(still, NackMessage)  # leave not yet below MSN
+    for c in clients:
+        if c != clients[5]:
+            s.ticket(c, op(1, leave.sequence_number))
+    retry = s.join()
+    assert retry.type == MessageType.CLIENT_JOIN
+    assert retry.contents["clientId"] == clients[5]

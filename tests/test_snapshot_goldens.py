@@ -134,3 +134,22 @@ if __name__ == "__main__":
         with open(os.path.join(GOLDEN_DIR, "golden_session.json"), "w") as f:
             json.dump(generate(), f, sort_keys=True, indent=1)
         print("goldens regenerated")
+
+
+def test_r1_format_summary_still_loads():
+    """Round-1 summaries (single removers-bitmask lane, no rbits2) must
+    keep loading after the writer-mask widening: load_core leaves missing
+    lanes at their empty defaults."""
+    with open(os.path.join(GOLDEN_DIR, "golden_session_r1.json")) as f:
+        golden = json.load(f)
+    assert "rbits2" not in golden["summary"]["channels"]["text"]["lanes"]
+    svc = LocalFluidService()
+    handle = svc.store.put_summary(golden["summary"])
+    doc = svc._doc("golden3")
+    doc.latest_summary = (handle, golden["summary"]["sequence_number"])
+    doc.sequencer.seq = golden["summary"]["sequence_number"]
+    rt = ContainerRuntime(
+        svc, "golden3", channels=(SharedString("text"), SharedMap("map"))
+    )
+    assert rt.get_channel("text").get_text() == golden["text"]
+    assert rt.get_channel("map").get("title") == "golden doc"
