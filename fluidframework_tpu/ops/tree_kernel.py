@@ -1,0 +1,331 @@
+"""Device kernel for SharedTree sequence-field changesets.
+
+Reference: ``packages/dds/tree/src/feature-libraries/sequence-field/
+{rebase,compose,invert}.ts`` co-iterate two run-length mark lists via a
+MarkQueue that splits marks to equal lengths (SURVEY.md Appendix B.3). The
+host mirror is ``tree/marks.py``. Here the same algebra is lowered to a
+**dense fixed-shape IR** where the co-iteration becomes prefix sums and
+scatters — the TPU-native form (no data-dependent control flow; every op is
+O(capacity) vector work, `vmap`-able across documents and `jit`-compiled).
+
+Dense IR for a changeset over an input document of length ``L`` (padded to
+static capacity ``Lc``, insert pool capacity ``Pc``):
+
+- ``del_mask[Lc]``   — 1 where input slot i is deleted;
+- ``ins_cnt[Lc+1]``  — how many items are inserted at boundary b (before
+  input slot b; boundary L = append);
+- ``ins_ids[Pc]``    — inserted item ids, concatenated in boundary order.
+
+Values ride as int32 ids; deletions are positional (values are implicit
+from the document), unlike the host IR whose ``del`` marks carry values —
+``invert`` therefore takes the document ids. The runs-within-a-boundary
+order of ``ins_ids`` IS the output order, which lets ``rebase`` keep the
+pool untouched (the boundary mapping is monotone).
+
+Tie policy matches ``marks.py``: rebasing the LATER-sequenced change puts
+its inserts before the earlier change's inserts at the same boundary
+(``c_after=False``); ``c_after=True`` mirrors.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DenseChange(NamedTuple):
+    """One changeset in dense IR (arrays may carry a leading batch dim)."""
+
+    del_mask: jnp.ndarray  # int32[Lc]
+    ins_cnt: jnp.ndarray  # int32[Lc+1]
+    ins_ids: jnp.ndarray  # int32[Pc]
+
+
+def empty_change(Lc: int, Pc: int) -> DenseChange:
+    return DenseChange(
+        jnp.zeros(Lc, jnp.int32),
+        jnp.zeros(Lc + 1, jnp.int32),
+        jnp.zeros(Pc, jnp.int32),
+    )
+
+
+def out_len(c: DenseChange, L: jnp.ndarray) -> jnp.ndarray:
+    """Length of c's output document."""
+    Lc = c.del_mask.shape[-1]
+    valid = jnp.arange(Lc) < L
+    bvalid = jnp.arange(Lc + 1) <= L
+    return L - jnp.sum(c.del_mask * valid) + jnp.sum(c.ins_cnt * bvalid)
+
+
+# -- scatter/search primitives as MXU matmuls --------------------------------
+#
+# jnp scatters (`.at[].add/set`) serialize on TPU (~ms per call at these
+# shapes — measured, not guessed); a one-hot matmul does the same dense
+# permutation as MXU work in microseconds. This is the same transport trick
+# as ops/pallas_compact.py. Out-of-range positions simply match no output
+# column — scatter-drop semantics for free (mask by driving pos to -1).
+
+_HIGHEST = jax.lax.Precision.HIGHEST
+
+
+def _onehot_f32(pos: jnp.ndarray, out_size: int) -> jnp.ndarray:
+    return (pos[:, None] == jnp.arange(out_size)[None, :]).astype(jnp.float32)
+
+
+def _scatter_add(pos: jnp.ndarray, vals: jnp.ndarray, out_size: int):
+    """out[p] = sum of vals where pos == p. Exact for |vals| sums < 2^24."""
+    oh = _onehot_f32(pos, out_size)
+    out = jax.lax.dot_general(
+        vals.astype(jnp.float32), oh, (((0,), (0,)), ((), ())),
+        precision=_HIGHEST,
+    )
+    return out.astype(jnp.int32)
+
+
+def _scatter_ids(pos: jnp.ndarray, ids: jnp.ndarray, out_size: int):
+    """out[p] = ids[i] where pos[i] == p (single writer per slot). 15-bit
+    hi/lo split keeps int32 ids exact through the f32 MXU path."""
+    oh = _onehot_f32(pos, out_size)
+    hi = jax.lax.dot_general(
+        (ids >> 15).astype(jnp.float32), oh, (((0,), (0,)), ((), ())),
+        precision=_HIGHEST,
+    )
+    lo = jax.lax.dot_general(
+        (ids & 0x7FFF).astype(jnp.float32), oh, (((0,), (0,)), ((), ())),
+        precision=_HIGHEST,
+    )
+    return hi.astype(jnp.int32) * 32768 + lo.astype(jnp.int32)
+
+
+def _count_leq(sorted_vals: jnp.ndarray, queries: jnp.ndarray):
+    """searchsorted(sorted_vals, queries, side='right') as a comparison
+    matrix reduction (binary-search gathers serialize on TPU)."""
+    return jnp.sum(
+        (sorted_vals[None, :] <= queries[:, None]).astype(jnp.int32), axis=1
+    )
+
+
+def _prefix(c: DenseChange, L: jnp.ndarray):
+    """Shared prefix sums. Returns (valid, keep, surv_pos, Dex_b, bcum)
+    where ``surv_pos[i]`` is slot i's position in c's output, ``Dex_b[b]``
+    counts deletions before boundary b, and ``bcum[b]`` counts inserted
+    items at boundaries <= b."""
+    Lc = c.del_mask.shape[-1]
+    idx = jnp.arange(Lc)
+    valid = idx < L
+    dmask = c.del_mask * valid
+    keep = valid & (dmask == 0)
+    Dex_b = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(dmask).astype(jnp.int32)]
+    )  # [Lc+1]: deletions in [0, b)
+    icnt = c.ins_cnt * (jnp.arange(Lc + 1) <= L)
+    bcum = jnp.cumsum(icnt).astype(jnp.int32)  # [Lc+1]: ins at [0..b]
+    surv_pos = idx - Dex_b[:Lc] + bcum[:Lc]
+    return valid, keep, surv_pos, Dex_b, bcum, icnt
+
+
+def _pool_boundaries(icnt: jnp.ndarray, Pc: int):
+    """Boundary b(k) of each insert-pool item k, plus validity mask and the
+    position of k's run start in the pool (exclusive cumulative)."""
+    bcum = jnp.cumsum(icnt).astype(jnp.int32)
+    k = jnp.arange(Pc)
+    total = bcum[-1]
+    kvalid = k < total
+    b_of_k = _count_leq(bcum, k)
+    bcum_at = jnp.take(bcum, jnp.clip(b_of_k, 0, icnt.shape[-1] - 1))
+    icnt_at = jnp.take(icnt, jnp.clip(b_of_k, 0, icnt.shape[-1] - 1))
+    run_start = bcum_at - icnt_at  # pool index where b's run began
+    return b_of_k, kvalid, run_start, total
+
+
+def apply_change(
+    doc_ids: jnp.ndarray, L: jnp.ndarray, c: DenseChange
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Apply a changeset; returns (new_ids[Lc], new_L). The output must fit
+    the same capacity (caller invariant)."""
+    Lc = doc_ids.shape[-1]
+    Pc = c.ins_ids.shape[-1]
+    valid, keep, surv_pos, Dex_b, bcum, icnt = _prefix(c, L)
+    out = _scatter_ids(jnp.where(keep, surv_pos, -1), doc_ids, Lc)
+    b_of_k, kvalid, run_start, total = _pool_boundaries(icnt, Pc)
+    # Output slot of pool item k: survivors before its boundary plus every
+    # pool item preceding it (the pool is globally output-ordered).
+    ins_pos = (b_of_k - jnp.take(Dex_b, b_of_k)) + jnp.arange(Pc)
+    out = out + _scatter_ids(jnp.where(kvalid, ins_pos, -1), c.ins_ids, Lc)
+    new_L = (L - Dex_b[-1]) + total
+    return out, new_L
+
+
+def rebase_change(
+    c: DenseChange, over: DenseChange, L: jnp.ndarray, c_after: bool = False
+) -> DenseChange:
+    """Rebase ``c`` over concurrent ``over`` (both read the same input of
+    length L); result reads over's output. The insert pool is untouched —
+    the boundary mapping is monotone, so pool order is preserved."""
+    Lc = c.del_mask.shape[-1]
+    valid, okeep, of_pos, oDex_b, obcum, oicnt = _prefix(over, L)
+    # Deletions: c's delete of a slot over also deleted vanishes; survivors
+    # map through over's output positions.
+    live_del = (c.del_mask * valid) * (1 - over.del_mask * valid)
+    del_out = _scatter_add(jnp.where(okeep, of_pos, -1), live_del, Lc)
+    # Boundaries: b -> over-output boundary. c-before-over tie (default)
+    # excludes over's own inserts at b; c_after includes them.
+    b = jnp.arange(Lc + 1)
+    bvalid = b <= L
+    incl = obcum
+    excl = obcum - oicnt
+    b_map = b - oDex_b + (incl if c_after else excl)
+    ins_out = _scatter_add(
+        jnp.where(bvalid, b_map, -1), c.ins_cnt, Lc + 1
+    )
+    return DenseChange(del_out, ins_out, c.ins_ids)
+
+
+def invert_change(
+    doc_ids: jnp.ndarray, L: jnp.ndarray, c: DenseChange
+) -> DenseChange:
+    """Inverse changeset over c's output (values for revives come from the
+    document, hence ``doc_ids``)."""
+    Lc = doc_ids.shape[-1]
+    Pc = c.ins_ids.shape[-1]
+    valid, keep, surv_pos, Dex_b, bcum, icnt = _prefix(c, L)
+    # Delete everything c inserted.
+    b_of_k, kvalid, run_start, total = _pool_boundaries(icnt, Pc)
+    ins_pos = (b_of_k - jnp.take(Dex_b, b_of_k)) + jnp.arange(Pc)
+    inv_del = _scatter_add(
+        jnp.where(kvalid, ins_pos, -1), jnp.ones(Pc, jnp.int32), Lc
+    )
+    # Re-insert everything c deleted, at its original spot among survivors
+    # (surv_pos evaluated as if the slot had survived).
+    deleted = valid & (c.del_mask != 0)
+    inv_ins = _scatter_add(
+        jnp.where(deleted, surv_pos, -1),
+        jnp.ones(Lc, jnp.int32),
+        Lc + 1,
+    )
+    # Pool: deleted ids in input order.
+    dpos = jnp.cumsum(deleted.astype(jnp.int32)) - 1
+    inv_ids = _scatter_ids(jnp.where(deleted, dpos, -1), doc_ids, Pc)
+    return DenseChange(inv_del, inv_ins, inv_ids)
+
+
+def compose_change(
+    a: DenseChange, b: DenseChange, L: jnp.ndarray
+) -> DenseChange:
+    """Changeset equivalent to applying ``a`` then ``b`` (b reads a's
+    output; the result reads a's input). The merged insert pool is built by
+    one sort over (a-output coordinate, source) keys — the dense form of
+    the reference's two-queue co-iteration."""
+    Lc = a.del_mask.shape[-1]
+    Pc = a.ins_ids.shape[-1]
+    valid, akeep, af_pos, aDex_b, abcum, aicnt = _prefix(a, L)
+    La = (L - aDex_b[-1]) + abcum[-1]
+
+    # --- deletions over the input -----------------------------------------
+    bdel_at = jnp.take(
+        b.del_mask, jnp.clip(af_pos, 0, Lc - 1), axis=-1
+    ) * (af_pos < Lc)
+    del_mask = jnp.where(
+        valid, jnp.maximum(a.del_mask, jnp.where(akeep, bdel_at, 0)), 0
+    ).astype(jnp.int32)
+
+    # --- a's insert pool: killed items (b deleted them) drop ---------------
+    a_b_of_k, a_kvalid, a_run_start, a_total = _pool_boundaries(aicnt, Pc)
+    a_pos = (a_b_of_k - aDex_b[a_b_of_k]) + jnp.arange(Pc)  # a-output pos
+    a_killed = jnp.take(
+        b.del_mask, jnp.clip(a_pos, 0, Lc - 1), axis=-1
+    ) * (a_pos < Lc)
+    a_live = a_kvalid & (a_killed == 0)
+
+    # --- map a-output coordinates back to input boundaries -----------------
+    # ainv[q] = input boundary owning a-output position q (survivor i -> i;
+    # a-ins item -> its run's boundary; q >= La -> L).
+    ainv = _scatter_ids(
+        jnp.where(akeep, af_pos, -1), jnp.arange(Lc), Lc + Pc + 1
+    ) + _scatter_ids(
+        jnp.where(a_kvalid, a_pos, -1), a_b_of_k, Lc + Pc + 1
+    )
+    # Positions at/after La belong to the implicit trailing skip: clamp to L
+    # via a running maximum is unnecessary — unset slots can only be ≥ La
+    # (every q < La is a survivor or an a-ins), set those to L.
+    qidx = jnp.arange(Lc + Pc + 1)
+    ainv = jnp.where(qidx >= La, L, ainv)
+
+    # --- merge pools by a-output coordinate --------------------------------
+    b_b_of_k, b_kvalid, b_run_start, b_total = _pool_boundaries(
+        b.ins_cnt * (jnp.arange(Lc + 1) <= La), Pc
+    )
+    BIG = Lc + Pc + 2
+    # b-inserts at a-output boundary p go BEFORE the element at p (key tag
+    # 0); surviving a-ins items sit AT their position (tag 1).
+    a_key = jnp.where(a_live, a_pos * 2 + 1, BIG * 2)
+    b_key = jnp.where(b_kvalid, b_b_of_k * 2, BIG * 2)
+    keys = jnp.concatenate([a_key, b_key])
+    ids = jnp.concatenate([a.ins_ids, b.ins_ids])
+    bounds = jnp.concatenate(
+        [
+            a_b_of_k,  # a-item keeps its input boundary
+            jnp.take(ainv, jnp.clip(b_b_of_k, 0, Lc + Pc), axis=-1),
+        ]
+    )
+    order = jnp.argsort(keys, stable=True)
+    sorted_ids = jnp.take(ids, order)
+    sorted_bounds = jnp.take(bounds, order)
+    sorted_live = jnp.take(keys, order) < BIG * 2
+    n_live = jnp.sum(sorted_live.astype(jnp.int32))
+    ins_ids = jnp.where(jnp.arange(2 * Pc) < n_live, sorted_ids, 0)[:Pc]
+    ins_cnt = _scatter_add(
+        jnp.where(sorted_live, sorted_bounds, -1),
+        jnp.ones(2 * Pc, jnp.int32),
+        Lc + 1,
+    )
+    return DenseChange(del_mask, ins_cnt, ins_ids)
+
+
+# -- host <-> dense conversion (test/bench plumbing, not the hot path) ------
+
+
+def from_marks(marks, Lc: int, Pc: int) -> Tuple[DenseChange, int]:
+    """Lower a tree/marks.py changeset (values must be int ids) to dense.
+    Returns (change, input_len). Arrays are HOST numpy — batch conversion
+    must not pay one tunnel round-trip per changeset; callers device_put
+    the stacked batch once."""
+    del_mask = np.zeros(Lc, np.int32)
+    ins_cnt = np.zeros(Lc + 1, np.int32)
+    ins_ids = np.zeros(Pc, np.int32)
+    i = 0
+    p = 0
+    for t, v in marks:
+        if t == "skip":
+            i += v
+        elif t == "del":
+            del_mask[i : i + len(v)] = 1
+            i += len(v)
+        else:
+            ins_cnt[i] += len(v)
+            ins_ids[p : p + len(v)] = v
+            p += len(v)
+    return DenseChange(del_mask, ins_cnt, ins_ids), i
+
+
+def doc_to_dense(doc, Lc: int) -> Tuple[jnp.ndarray, int]:
+    ids = np.zeros(Lc, np.int32)
+    ids[: len(doc)] = doc
+    return jnp.asarray(ids), len(doc)
+
+
+def dense_to_doc(ids: jnp.ndarray, L) -> list:
+    return [int(x) for x in np.asarray(ids)[: int(L)]]
+
+
+# -- batched/jitted entry points --------------------------------------------
+
+batched_apply = jax.jit(jax.vmap(apply_change))
+batched_rebase = jax.jit(
+    jax.vmap(rebase_change, in_axes=(0, 0, 0, None)), static_argnums=(3,)
+)
+batched_invert = jax.jit(jax.vmap(invert_change))
+batched_compose = jax.jit(jax.vmap(compose_change))

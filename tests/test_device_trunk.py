@@ -1,0 +1,45 @@
+"""Device trunk scan vs a host rebase-based trunk (the reference
+EditManager algorithm, editManager.ts:142-281, run with tree/marks.py)."""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.ops import tree_kernel as TK
+from fluidframework_tpu.tree import marks as M
+from fluidframework_tpu.testing.tree_streams import (
+    gen_streams,
+    host_trunk,
+    to_device_batch,
+)
+from fluidframework_tpu.tree.device_trunk import batched_trunk_scan
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_device_trunk_matches_host(seed):
+    rng = np.random.default_rng(seed + 9000)
+    Lc, Pc, W = 64, 32, 8
+    n_docs, C = 4, 24
+    streams = gen_streams(rng, n_docs, C, n_sessions=3, W=W, Lc=Lc)
+    batch = to_device_batch(streams, Lc, Pc)
+    doc_ids = np.zeros((n_docs, Lc), np.int32)
+    L0 = np.zeros(n_docs, np.int32)
+    out_ids, out_L = batched_trunk_scan(doc_ids, L0, batch, W)
+    for d in range(n_docs):
+        want = host_trunk(streams[d])
+        got = TK.dense_to_doc(out_ids[d], out_L[d])
+        assert got == want, f"doc {d}: {got} != {want}"
+
+
+def test_device_trunk_single_session_is_sequential_apply():
+    """One session, no concurrency: the trunk is just sequential apply."""
+    Lc, Pc, W = 32, 16, 4
+    commits = [
+        (0, [M.insert([1, 2, 3])]),
+        (1, [M.skip(1), M.delete([2])]),
+        (2, [M.skip(2), M.insert([4])]),
+    ]
+    batch = to_device_batch([commits], Lc, Pc)
+    out_ids, out_L = batched_trunk_scan(
+        np.zeros((1, Lc), np.int32), np.zeros(1, np.int32), batch, W
+    )
+    assert TK.dense_to_doc(out_ids[0], out_L[0]) == [1, 3, 4]

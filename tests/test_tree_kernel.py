@@ -1,0 +1,163 @@
+"""Device tree-changeset kernel vs the host mark algebra.
+
+Every law pinned by ``test_tree_marks.py`` re-checks here THROUGH the dense
+device kernel (vmapped/jitted), plus direct parity: random host changesets
+lowered to the dense IR must produce identical documents through apply/
+rebase/invert/compose on both implementations. On CI this runs on the
+virtual CPU backend; the bench artifact runs the same kernels on real TPU.
+"""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.ops import tree_kernel as TK
+from fluidframework_tpu.tree import marks as M
+from test_tree_marks import random_change, random_state
+
+LC, PC = 48, 48
+
+
+def dense(c):
+    return TK.from_marks(c, LC, PC)
+
+
+def run_apply(doc, c):
+    ids, L = TK.doc_to_dense(doc, LC)
+    dc, _ = dense(c)
+    out, out_L = TK.batched_apply(
+        ids[None], np.asarray([L], np.int32), tree_map_batch(dc)
+    )
+    return TK.dense_to_doc(out[0], out_L[0])
+
+
+def tree_map_batch(dc):
+    return TK.DenseChange(*[x[None] for x in dc])
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_apply_parity(seed):
+    rng = np.random.default_rng(seed)
+    s = random_state(rng)
+    c = random_change(rng, s)
+    assert run_apply(s, c) == M.apply(s, c)
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_invert_roundtrip_on_device(seed):
+    rng = np.random.default_rng(seed + 500)
+    s = random_state(rng)
+    c = random_change(rng, s)
+    ids, L = TK.doc_to_dense(s, LC)
+    dc, _ = dense(c)
+    Lb = np.asarray([L], np.int32)
+    out, out_L = TK.batched_apply(ids[None], Lb, tree_map_batch(dc))
+    inv = TK.batched_invert(ids[None], Lb, tree_map_batch(dc))
+    back, back_L = TK.batched_apply(out, out_L, inv)
+    assert TK.dense_to_doc(back[0], back_L[0]) == s
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_rebase_convergence_on_device(seed):
+    """Two-client law through the device kernel: apply(a) + rebase(b, a)
+    equals apply(b) + rebase(a, b, mirrored tie)."""
+    rng = np.random.default_rng(seed + 3000)
+    s = random_state(rng)
+    a = random_change(rng, s)
+    b = random_change(rng, s)
+    ids, L = TK.doc_to_dense(s, LC)
+    Lb = np.asarray([L], np.int32)
+    da, db = tree_map_batch(dense(a)[0]), tree_map_batch(dense(b)[0])
+    sa, La_ = TK.batched_apply(ids[None], Lb, da)
+    b_on_a = TK.batched_rebase(db, da, Lb, False)
+    via_a, via_a_L = TK.batched_apply(sa, La_, b_on_a)
+    sb, Lb_ = TK.batched_apply(ids[None], Lb, db)
+    a_on_b = TK.batched_rebase(da, db, Lb, True)
+    via_b, via_b_L = TK.batched_apply(sb, Lb_, a_on_b)
+    got_a = TK.dense_to_doc(via_a[0], via_a_L[0])
+    got_b = TK.dense_to_doc(via_b[0], via_b_L[0])
+    assert got_a == got_b
+    # And both match the host algebra.
+    assert got_a == M.apply(M.apply(s, a), M.rebase(b, a))
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_compose_parity(seed):
+    rng = np.random.default_rng(seed + 1000)
+    s = random_state(rng)
+    a = random_change(rng, s)
+    mid = M.apply(s, a)
+    b = random_change(rng, mid)
+    ids, L = TK.doc_to_dense(s, LC)
+    Lb = np.asarray([L], np.int32)
+    da = tree_map_batch(dense(a)[0])
+    db = tree_map_batch(dense(b)[0])
+    ab = TK.batched_compose(da, db, Lb)
+    out, out_L = TK.batched_apply(ids[None], Lb, ab)
+    assert TK.dense_to_doc(out[0], out_L[0]) == M.apply(s, M.compose(a, b))
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_compose_associative_on_device(seed):
+    rng = np.random.default_rng(seed + 2000)
+    s = random_state(rng)
+    a = random_change(rng, s)
+    s1 = M.apply(s, a)
+    b = random_change(rng, s1)
+    s2 = M.apply(s1, b)
+    c = random_change(rng, s2)
+    ids, L = TK.doc_to_dense(s, LC)
+    Lb = np.asarray([L], np.int32)
+    da, db, dc = (tree_map_batch(dense(x)[0]) for x in (a, b, c))
+    ab = TK.batched_compose(da, db, Lb)
+    left = TK.batched_compose(ab, dc, Lb)
+    La1 = TK.out_len(TK.DenseChange(*[x[0] for x in da]), np.int32(L))
+    bc = TK.batched_compose(db, dc, np.asarray([La1], np.int32))
+    right = TK.batched_compose(da, bc, Lb)
+    o1, l1 = TK.batched_apply(ids[None], Lb, left)
+    o2, l2 = TK.batched_apply(ids[None], Lb, right)
+    assert TK.dense_to_doc(o1[0], l1[0]) == TK.dense_to_doc(o2[0], l2[0])
+    assert TK.dense_to_doc(o1[0], l1[0]) == M.apply(
+        s, M.compose(M.compose(a, b), c)
+    )
+
+
+def test_rebase_insert_tie_later_lands_left_on_device():
+    s = [1, 2]
+    a = [M.skip(1), M.insert([10])]
+    b = [M.skip(1), M.insert([20])]
+    ids, L = TK.doc_to_dense(s, LC)
+    Lb = np.asarray([L], np.int32)
+    da, db = tree_map_batch(dense(a)[0]), tree_map_batch(dense(b)[0])
+    sa, La_ = TK.batched_apply(ids[None], Lb, da)
+    merged, mL = TK.batched_apply(sa, La_, TK.batched_rebase(db, da, Lb, False))
+    assert TK.dense_to_doc(merged[0], mL[0]) == [1, 20, 10, 2]
+
+
+def test_rebase_insert_inside_deleted_range_slides_on_device():
+    s = [1, 2, 3, 4]
+    o = [M.skip(1), M.delete([2, 3])]
+    c = [M.skip(2), M.insert([9])]
+    ids, L = TK.doc_to_dense(s, LC)
+    Lb = np.asarray([L], np.int32)
+    do, dc = tree_map_batch(dense(o)[0]), tree_map_batch(dense(c)[0])
+    so, Lo = TK.batched_apply(ids[None], Lb, do)
+    out, oL = TK.batched_apply(so, Lo, TK.batched_rebase(dc, do, Lb, False))
+    assert TK.dense_to_doc(out[0], oL[0]) == [1, 9, 4]
+
+
+def test_batched_independence():
+    """Different changesets in one batch don't interfere (vmap sanity)."""
+    rng = np.random.default_rng(42)
+    docs, changes = [], []
+    for _ in range(8):
+        s = random_state(rng, 6)
+        docs.append(s)
+        changes.append(random_change(rng, s))
+    ids = np.stack([TK.doc_to_dense(s, LC)[0] for s in docs])
+    Ls = np.asarray([len(s) for s in docs], np.int32)
+    dcs = [dense(c)[0] for c in changes]
+    batch = TK.DenseChange(*[np.stack([np.asarray(getattr(d, f)) for d in dcs])
+                             for f in ("del_mask", "ins_cnt", "ins_ids")])
+    out, out_L = TK.batched_apply(ids, Ls, batch)
+    for i in range(8):
+        assert TK.dense_to_doc(out[i], out_L[i]) == M.apply(docs[i], changes[i])
