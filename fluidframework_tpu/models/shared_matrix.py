@@ -85,6 +85,14 @@ class SharedMatrix(SharedObject):
         for vec in (self._rows, self._cols):
             vec.state = adopt_client_slot(vec.state, new_client_id)
 
+    def adopt_stashed_slot(self, old_client_id: int) -> None:
+        import jax.numpy as jnp
+
+        for vec in (self._rows, self._cols):
+            vec.state = vec.state._replace(
+                self_client=jnp.int32(old_client_id)
+            )
+
     def attach(self, runtime) -> None:
         super().attach(runtime)
         self._rows = _PermutationVector(self._capacity, self.client_id)
@@ -267,6 +275,17 @@ class SharedMatrix(SharedObject):
 
         self._rows = restore(summary["rows"])
         self._cols = restore(summary["cols"])
+        # A stashed-state snapshot may carry pending rows (unacked lseq
+        # stamps): future local ops must not collide with them.
+        self._lseq = max(
+            [0]
+            + [
+                int(v)
+                for d in (summary["rows"], summary["cols"])
+                for lane in ("lseq", "rlseq", "alseq")
+                for v in d["lanes"].get(lane, [])
+            ]
+        )
         self._cells = {}
         for key, v in summary["cells"].items():
             a, b, c, d = (int(x) for x in key.split(":"))

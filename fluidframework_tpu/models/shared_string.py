@@ -344,6 +344,13 @@ class SharedString(SharedObject):
         self._mint = 0  # content ids scope to the connection ordinal
         self._state = adopt_client_slot(self._state, new_client_id)
 
+    def adopt_stashed_slot(self, old_client_id: int) -> None:
+        import jax.numpy as jnp
+
+        self._state = self._state._replace(
+            self_client=jnp.int32(old_client_id)
+        )
+
     def begin_resubmit(self) -> None:
         # All regenerations in one batch read the reconnect-time state;
         # restamps land on the live state without perturbing the view.
@@ -464,5 +471,14 @@ class SharedString(SharedObject):
             cur_seq=jnp.int32(summary["cur_seq"]),
         )
         self._payloads = {int(k): v for k, v in summary["payloads"].items()}
+        # A stashed-state snapshot may carry pending rows (unacked lseq
+        # stamps): future local ops must not collide with them.
+        lanes = summary["lanes"]
+        self._lseq = max(
+            [0]
+            + list(lanes.get("lseq", []))
+            + list(lanes.get("rlseq", []))
+            + list(lanes.get("alseq", []))
+        )
         for label, entries in summary.get("intervals", {}).items():
             self.get_interval_collection(label).load(entries)

@@ -203,9 +203,17 @@ class SparseMatrix(SharedObject):
 
     def summarize_core(self) -> dict:
         from fluidframework_tpu.ops.segment_state import to_host
+        from fluidframework_tpu.protocol.constants import UNASSIGNED_SEQ
 
         assert not self._cell_pending
         h = to_host(self._rows.state)
+        # Deprecated DDS: snapshots are acked-state only (load_core replays
+        # rows as baseline inserts). Stashing pending rows through it would
+        # silently ack them — refuse loudly instead.
+        assert not any(
+            int(h.seq[i]) == UNASSIGNED_SEQ or int(h.rseq[i]) == UNASSIGNED_SEQ
+            for i in range(int(h.count))
+        ), "SparseMatrix snapshots cannot carry pending (unacked) rows"
         rows = []
         for i in range(int(h.count)):
             rows.append(

@@ -29,6 +29,7 @@ class MessageType(enum.IntEnum):
     CONTROL = 10
     SIGNAL = 11
     ATTACH = 12  # dynamic channel/datastore creation (reference "attach" op)
+    BLOB_ATTACH = 13  # bind a blob localId -> storageId (blobManager.ts)
 
 
 class NackErrorType(enum.IntEnum):

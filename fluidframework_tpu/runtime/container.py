@@ -49,6 +49,7 @@ class ContainerRuntime:
         chunk_size: Optional[int] = DEFAULT_CHUNK_SIZE,
         gc_options: Optional[GCOptions] = None,
         channel_types: Optional[Dict[str, Callable[[str], SharedObject]]] = None,
+        _stashed: Optional[dict] = None,
     ):
         """Connect and catch up to head before becoming interactive
         (reference Container.load, container.ts:300: snapshot + delta replay
@@ -61,7 +62,11 @@ class ContainerRuntime:
         self._service = service
         self._mode = mode
         self.connected = True
-        self.connection = service.connect(doc_id, mode)
+        stashed = _stashed  # passed by rehydrate()
+        self.connection = service.connect(
+            doc_id, mode,
+            from_seq=stashed["ref_seq"] if stashed is not None else 0,
+        )
         self.client_id = self.connection.client_id
         self._join_seq = getattr(self.connection, "join_seq", 0)
         self.conn_no = getattr(self.connection, "conn_no", 0) or (
@@ -119,6 +124,10 @@ class ContainerRuntime:
         # (they live outside the op outbox, so pending-state replay alone
         # would lose them).
         self._pending_attaches: Dict[str, str] = {}
+        # Attachment blobs (reference blobManager.ts; VERDICT r1 Missing #2).
+        from fluidframework_tpu.runtime.blob_manager import BlobManager
+
+        self.blobs = BlobManager(self)
         # Channels we couldn't realize (type missing from the registry):
         # ops to them are an error and their summaries carry forward verbatim
         # — silently dropping them would erase data for capable clients.
@@ -126,9 +135,12 @@ class ContainerRuntime:
         self._carried_summaries: Dict[str, dict] = {}
         for ch in channels:
             self.create_channel(ch)
-        if self.connection.initial_summary is not None:
-            self._load_summary(self.connection.initial_summary)
-        self.process_incoming()  # catch up to head
+        if stashed is not None:
+            self._apply_stashed_state(stashed)
+        else:
+            if self.connection.initial_summary is not None:
+                self._load_summary(self.connection.initial_summary)
+            self.process_incoming()  # catch up to head
 
     # -- channels -------------------------------------------------------------
 
@@ -224,6 +236,14 @@ class ContainerRuntime:
         if self.gc.is_tombstoned(f"/{channel_id}"):
             raise TombstoneError(f"/{channel_id} is tombstoned")
         return self.channels[channel_id]
+
+    def upload_blob(self, data: bytes) -> dict:
+        """Upload an attachment blob; returns its storable handle
+        (reference ContainerRuntime.uploadBlob -> BlobManager)."""
+        return self.blobs.upload_blob(data)
+
+    def get_blob(self, handle) -> bytes:
+        return self.blobs.get_blob(handle)
 
     def handle_for(self, channel_id: str, sub_id: Optional[str] = None) -> dict:
         """Encoded handle referencing a channel (or a datastore child) —
@@ -473,6 +493,8 @@ class ContainerRuntime:
                 self._pending_attaches.pop(cid, None)
             if cid not in self.channels:
                 self._realize_channel(cid, type_name, msg.contents.get("root", False))
+        elif msg.type == MessageType.BLOB_ATTACH:
+            self.blobs.process_attach(msg.contents)
         elif msg.type == MessageType.PROPOSE:
             # Quorum proposal (reference protocol-base/src/quorum.ts): keyed
             # by its sequence number, approved once MSN reaches it (every
@@ -622,14 +644,19 @@ class ContainerRuntime:
             ch.on_reconnect(self.client_id)
         offline, self._offline = self._offline, []
         self._offline_folded = 0
+        self._catch_up_and_resubmit(offline)
+
+    def _catch_up_and_resubmit(self, offline: list) -> None:
+        """Shared reconnect/rehydrate tail: catch up to head, re-announce
+        attach and blob state, then resubmit the offline tail — parked
+        behind any unresolved prior generations so authored order holds
+        across connections (the reference's single ordered
+        PendingStateManager list has this property by construction) —
+        and finally replay buffered proposals."""
         self.process_incoming()  # catch up before rebasing
         self._resend_pending_attaches()
+        self.blobs.on_reconnect()
         if self._prior_gens and offline:
-            # Earlier-authored in-flight ops still await their LEAVEs: park
-            # the offline edits as a synthetic (already-resolved) generation
-            # behind them so resubmission preserves authored order across
-            # connections (the reference's single ordered PendingStateManager
-            # list has this property by construction).
             self._prior_gens.append(
                 {
                     "client_id": None,
@@ -721,6 +748,117 @@ class ContainerRuntime:
                 key, value = self.pending_proposals.pop(seq)
                 self.approved_proposals[key] = value
 
+    # -- stashed-op close + rehydrate (pendingStateManager.ts:205,
+    #    containerRuntime.ts:3248 getPendingLocalState, VERDICT r1 #7) ------
+
+    def get_pending_local_state(self) -> dict:
+        """Serializable snapshot for closing the process and resuming in a
+        later session: the full container state at ref_seq (channel
+        snapshots INCLUDE pending rows — unacked local stamps ride the
+        state lanes — plus quorum/proposals/blob bindings/GC), in-flight
+        ops parked per dead-connection generation (their fate resolves
+        during rehydrate catch-up exactly like an ungraceful reconnect),
+        and the never-sent offline tail."""
+        gens = [
+            {
+                "client_id": gen["client_id"],
+                "join_seq": gen["join_seq"],
+                "pending": [
+                    list(e) for e in gen["pending"]
+                ],
+                "proposals": [list(p) for p in gen["proposals"]],
+                "entries": [list(e) for e in (gen.get("entries") or [])],
+                "resolved": bool(gen.get("resolved")),
+            }
+            for gen in self._prior_gens
+        ]
+        if self.pending or self._inflight_proposals:
+            gens.append(
+                {
+                    "client_id": self.client_id,
+                    "join_seq": self._join_seq,
+                    "pending": [list(e) for e in self.pending],
+                    "proposals": [
+                        list(p) for p in self._inflight_proposals
+                    ],
+                    "entries": [],
+                    "resolved": False,
+                }
+            )
+        offline = list(self._offline) + list(self._outbox)
+        return {
+            "ref_seq": self.ref_seq,
+            # The slot whose stamps ride the channel snapshots: pending-row
+            # restamping at rehydrate moves bits FROM this slot.
+            "client_id": self.client_id,
+            "summary": self._container_state_snapshot(),
+            "gens": gens,
+            "offline": [list(e) for e in offline],
+            "offline_proposals": [list(p) for p in self._offline_proposals],
+            "pending_attaches": {
+                cid: list(tr) for cid, tr in self._pending_attaches.items()
+            },
+            "blobs": self.blobs.get_pending_state(),
+        }
+
+    @classmethod
+    def rehydrate(
+        cls,
+        service,
+        doc_id: str,
+        stashed: dict,
+        channels: tuple = (),
+        channel_types=None,
+        **kw,
+    ) -> "ContainerRuntime":
+        """Resume a closed session: restore channel state (including the
+        optimistic pending rows) from the stash, catch up from the stash's
+        ref seq, then regenerate every recorded entry through the per-
+        channel resubmit path — the reference's applyStashedOpsAt flow."""
+        return cls(
+            service, doc_id, channels=channels, channel_types=channel_types,
+            _stashed=stashed, **kw,
+        )
+
+    def _apply_stashed_state(self, stashed: dict) -> None:
+        """Runs inside __init__ in place of summary load + plain catch-up.
+        The flow is an ungraceful reconnect whose prior state comes from
+        disk: in-flight generations park under their dead identities (so
+        catch-up echoes ack them instead of double-applying, and only
+        their LEAVEs trigger resubmission of the unsequenced remainder),
+        and the offline tail queues behind them in authored order."""
+        self._load_summary_dict(stashed["summary"], stashed["ref_seq"])
+        # Stashed pending rows carry the closed session's client slot;
+        # adopt this connection's (same restamp as reconnect — the old
+        # slot must be current first so the removers bits move).
+        gens = stashed.get("gens", [])
+        old_id = stashed.get("client_id")
+        for ch in self.channels.values():
+            if old_id is not None:
+                ch.adopt_stashed_slot(old_id)
+            ch.on_reconnect(self.client_id)
+        self._prior_gens = [
+            {
+                "client_id": g["client_id"],
+                "join_seq": g["join_seq"],
+                "pending": deque(tuple(e) for e in g["pending"]),
+                "proposals": deque(tuple(p) for p in g["proposals"]),
+                "entries": [tuple(e) for e in g.get("entries", [])],
+                "resolved": bool(g.get("resolved")),
+            }
+            for g in gens
+        ]
+        offline = [tuple(e) for e in stashed.get("offline", [])]
+        self._offline_proposals = [
+            tuple(p) for p in stashed.get("offline_proposals", [])
+        ]
+        self._pending_attaches = {
+            cid: tuple(tr)
+            for cid, tr in stashed.get("pending_attaches", {}).items()
+        }
+        self.blobs.load_pending_state(stashed.get("blobs", {}))
+        self._catch_up_and_resubmit(offline)
+
     # -- summaries (§3.4: summarize -> upload -> Summarize op -> scribe ack) --
 
     def run_gc(self, channel_summaries: Optional[dict] = None) -> GCResult:
@@ -756,6 +894,9 @@ class ContainerRuntime:
             graph[f"/{cid}"] = collect_handle_routes(carried)
             if self._unrealized.get(cid, (None, False))[1]:
                 roots.add(cid)
+        # Blob bindings participate as leaf nodes: alive only while some
+        # channel state holds their handle (blobManager GC integration).
+        graph.update(self.blobs.gc_routes())
         return self.gc.collect(graph, [f"/{cid}" for cid in sorted(roots)])
 
     def summarize(self) -> dict:
@@ -785,9 +926,41 @@ class ContainerRuntime:
             },
             "approved": dict(self.approved_proposals),
             "channels": channel_summaries,
+            "blobs": self.blobs.summarize(gc_result.swept),
             "channel_types": {
                 cid: t
                 for cid, t in {**self._channel_types, **self._unrealized}.items()
+                if cid in channel_summaries
+            },
+            "gc": self.gc.summarize(),
+        }
+
+    def _container_state_snapshot(self) -> dict:
+        """The container-level replica state at ref_seq as a summary-shaped
+        dict (everything _load_summary_dict restores): channel trees,
+        quorum, proposals, blob bindings, channel types, GC state. Unlike
+        summarize() this takes no GC pass and allows pending local state —
+        channel snapshots simply include the pending rows."""
+        channel_summaries = {
+            cid: ch.summarize_core() for cid, ch in self.channels.items()
+        }
+        channel_summaries.update(self._carried_summaries)
+        return {
+            "sequence_number": self.ref_seq,
+            "quorum": [
+                self.quorum_members[cid] for cid in sorted(self.quorum_members)
+            ],
+            "proposals": {
+                str(seq): list(kv) for seq, kv in self.pending_proposals.items()
+            },
+            "approved": dict(self.approved_proposals),
+            "channels": channel_summaries,
+            "blobs": dict(self.blobs.bindings),
+            "channel_types": {
+                cid: t
+                for cid, t in {
+                    **self._channel_types, **self._unrealized
+                }.items()
                 if cid in channel_summaries
             },
             "gc": self.gc.summarize(),
@@ -797,6 +970,9 @@ class ContainerRuntime:
         handle, seq = initial
         summary = self._service.store.get_summary(handle)
         assert summary["sequence_number"] == seq
+        self._load_summary_dict(summary, seq)
+
+    def _load_summary_dict(self, summary: dict, seq: int) -> None:
         # Dynamically attached channels are reconstructed from their recorded
         # (type, root) before their summaries load (their ATTACH op is below
         # the summary seq, so replay won't recreate them). Unknown types keep
@@ -823,6 +999,7 @@ class ContainerRuntime:
             for seq_key, kv in summary["proposals"].items()
         }
         self.approved_proposals = dict(summary["approved"])
+        self.blobs.load(summary.get("blobs"))
         self.gc.load(summary.get("gc", {}))
         self.ref_seq = seq
         self.last_summary_seq = seq
@@ -860,6 +1037,8 @@ class ContainerRuntime:
             or self._outbox
             or self._offline
             or self._prior_gens
+            or self.blobs.pending
+            or self.blobs.offline
         )
 
     def _maybe_auto_summarize(self) -> None:

@@ -111,6 +111,10 @@ class FluidDataStore(SharedObject):
         for ch in self.channels.values():
             ch.on_reconnect(new_client_id)
 
+    def adopt_stashed_slot(self, old_client_id: int) -> None:
+        for ch in self.channels.values():
+            ch.adopt_stashed_slot(old_client_id)
+
     def begin_resubmit(self) -> None:
         for ch in self.channels.values():
             ch.begin_resubmit()

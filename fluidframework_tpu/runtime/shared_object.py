@@ -82,6 +82,12 @@ class SharedObject(TypedEventEmitter, abc.ABC):
         """Connection-change hook: kernel-backed DDSes update their local
         client slot so new local ops stamp correctly."""
 
+    def adopt_stashed_slot(self, old_client_id: int) -> None:
+        """Stashed-state rehydration: pending rows in a loaded snapshot
+        carry the CLOSED session's client slot, but load_core stamped the
+        state with the new one — record the old slot as current so the
+        subsequent on_reconnect restamp moves the right removers bits."""
+
     def begin_resubmit(self) -> None:
         """Marks the start of a resubmit batch: rebase computations must all
         read the state as of reconnect, not interleaved restamps."""
