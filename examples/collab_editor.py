@@ -20,15 +20,17 @@ from fluidframework_tpu.service.network_server import FluidNetworkServer
 
 
 def drain(runtimes, timeout=60.0):
-    """Flush, then poll to quiescence with a deadline (socket delivery is
-    asynchronous — three consecutive quiet rounds means settled)."""
+    """Flush, then poll to quiescence with a deadline. Socket delivery is
+    asynchronous: require half a second of continuous silence before
+    declaring settled — a short quiet streak misfires on loaded machines
+    while a message is still in flight."""
     import time
 
     for rt in runtimes:
         rt.flush()
     deadline = time.monotonic() + timeout
     quiet = 0
-    while quiet < 3 and time.monotonic() < deadline:
+    while quiet < 25 and time.monotonic() < deadline:
         if any(rt.process_incoming() for rt in runtimes):
             quiet = 0
         else:

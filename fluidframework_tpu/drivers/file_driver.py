@@ -54,6 +54,12 @@ def save_document(service: LocalFluidService, doc_id: str, path: str) -> None:
         _copy_blob(service.store, blob_dir, tree_handle)
         for h in service.store.get_tree(tree_handle).values():
             _copy_blob(service.store, blob_dir, h)
+            # Chunked channel bodies reference further chunk blobs from a
+            # 'chunks:' index blob — copy those too or loads fail.
+            body = service.store.get_blob(h)
+            if body.startswith(b"chunks:"):
+                for ch in json.loads(body[len(b"chunks:"):]):
+                    _copy_blob(service.store, blob_dir, ch)
 
 
 def _copy_blob(store: SummaryStore, blob_dir: str, handle: str) -> None:

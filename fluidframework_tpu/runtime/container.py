@@ -109,6 +109,11 @@ class ContainerRuntime:
         # Summary tracking (reference SummaryCollection / RunningSummarizer).
         self.last_summary_seq = 0
         self.summary_interval: Optional[int] = None  # auto-summarize period
+        # Incremental summaries (reference ISummaryHandle, summary.ts:10-15):
+        # per-channel last-change seq + the last ACKED summary; channels
+        # untouched since it upload a handle instead of their full tree.
+        self._channel_last_change: Dict[str, int] = {}
+        self._acked_summary: Optional[tuple] = None  # (handle, head seq)
         # GC (D.3): root channels are always reachable (aliased datastores);
         # non-root ones live only while a handle somewhere references them.
         self.gc = GarbageCollector(gc_options)
@@ -489,6 +494,7 @@ class ContainerRuntime:
             # attach before any op on the channel guarantees a target exists
             # on every replica.
             cid, type_name = msg.contents["id"], msg.contents["type"]
+            self._channel_last_change[cid] = msg.sequence_number
             if self._is_own_echo(msg):
                 self._pending_attaches.pop(cid, None)
             if cid not in self.channels:
@@ -515,6 +521,7 @@ class ContainerRuntime:
         elif msg.type == MessageType.OPERATION:
             address = msg.contents["address"]
             inner = msg.contents["contents"]
+            self._channel_last_change[address] = msg.sequence_number
             assert address not in self._unrealized, (
                 f"op for channel {address!r} of unknown type "
                 f"{self._unrealized.get(address)!r} — register the type "
@@ -557,6 +564,13 @@ class ContainerRuntime:
             self.last_summary_seq = max(
                 self.last_summary_seq, msg.contents["head"]
             )
+            if msg.contents["head"] >= (
+                self._acked_summary[1] if self._acked_summary else -1
+            ):
+                self._acked_summary = (
+                    msg.contents["handle"],
+                    msg.contents["head"],
+                )
         self._check_proposals()
         self._maybe_auto_summarize()
         if self.on_op is not None:
@@ -916,6 +930,27 @@ class ContainerRuntime:
         for route in gc_result.swept:
             cid = route.lstrip("/").split("/", 1)[0]
             channel_summaries.pop(cid, None)
+        # Incremental reuse (ISummaryHandle, sharedObject.ts:722): a channel
+        # untouched since the last ACKED summary uploads an O(1) handle to
+        # its previous blob instead of its full tree. (GC above still reads
+        # the in-memory state — reuse saves upload bytes, which is the
+        # scaling cliff at fleet size, not serialization CPU.)
+        if self._acked_summary is not None:
+            prev_handle, prev_head = self._acked_summary
+            try:
+                prev_blobs = self._service.store.channel_blob_handles(
+                    prev_handle
+                )
+            except Exception:
+                prev_blobs = {}  # pruned/unknown tree: fall back to full
+            from fluidframework_tpu.service.summary_store import summary_handle
+
+            for cid in list(channel_summaries):
+                if (
+                    self._channel_last_change.get(cid, 0) <= prev_head
+                    and cid in prev_blobs
+                ):
+                    channel_summaries[cid] = summary_handle(prev_blobs[cid])
         return {
             "sequence_number": self.ref_seq,
             "quorum": [
@@ -971,6 +1006,9 @@ class ContainerRuntime:
         summary = self._service.store.get_summary(handle)
         assert summary["sequence_number"] == seq
         self._load_summary_dict(summary, seq)
+        # The served summary is by definition acked: channels untouched
+        # since it can reuse its blobs in our own first summary.
+        self._acked_summary = (handle, seq)
 
     def _load_summary_dict(self, summary: dict, seq: int) -> None:
         # Dynamically attached channels are reconstructed from their recorded

@@ -49,18 +49,41 @@ def scribe_decide(msg, protocol_head: int, store: "SummaryStore"):
     }
 
 
+SUMMARY_HANDLE_KEY = "__summary_handle__"
+
+
+def summary_handle(blob_handle: str) -> dict:
+    """An ISummaryHandle analog (protocol-definitions summary.ts:10-15):
+    'this subtree is unchanged — reuse the blob the previous summary
+    uploaded'. O(1) bytes regardless of channel size."""
+    return {SUMMARY_HANDLE_KEY: blob_handle}
+
+
+def is_summary_handle(node) -> bool:
+    return isinstance(node, dict) and SUMMARY_HANDLE_KEY in node
+
+
 class SummaryStore:
     """Content-addressed store over a pluggable blob backend: the native
     C++ store (``native/ca_store.cpp``, optionally disk-persistent) when
     available, else an in-memory dict (the TestHistorian analog). Both key
     blobs by SHA-256, so handles are interchangeable."""
 
-    def __init__(self, backend=None, native: bool = False, directory=None):
+    def __init__(
+        self,
+        backend=None,
+        native: bool = False,
+        directory=None,
+        chunk_bytes: int = 256 * 1024,
+    ):
         if backend is None and native:
             from fluidframework_tpu.utils.native import NativeBlobStore
 
             backend = NativeBlobStore(directory)
         self._backend = backend or _DictBackend()
+        # Channel blobs larger than this split into chunk blobs (reference
+        # merge-tree snapshotChunks.ts): bounded blob sizes for transport.
+        self.chunk_bytes = chunk_bytes
 
     # -- blobs ----------------------------------------------------------------
 
@@ -86,14 +109,43 @@ class SummaryStore:
 
     # -- whole summaries ------------------------------------------------------
 
+    def _put_channel_blob(self, data: bytes) -> str:
+        """Store one channel body, chunking oversized payloads into bounded
+        blobs joined by a chunk-index blob (snapshotChunks.ts analog)."""
+        if len(data) <= self.chunk_bytes:
+            return self.put_blob(data)
+        chunks = [
+            self.put_blob(data[i : i + self.chunk_bytes])
+            for i in range(0, len(data), self.chunk_bytes)
+        ]
+        return self.put_blob(
+            b"chunks:" + json.dumps(chunks, sort_keys=True).encode()
+        )
+
+    def _get_channel_blob(self, handle: str) -> bytes:
+        data = self.get_blob(handle)
+        if data.startswith(b"chunks:"):
+            return b"".join(
+                self.get_blob(h) for h in json.loads(data[len(b"chunks:"):])
+            )
+        return data
+
     def put_summary(self, summary: dict) -> str:
         """Store a runtime summary as one tree of per-channel blobs (the
-        shredded-summary layout: unchanged channels re-hash identically)."""
+        shredded-summary layout: unchanged channels re-hash identically).
+        A channel entry that is a summary HANDLE reuses the referenced
+        blob directly — zero new bytes for unchanged channels (the
+        incremental ISummaryHandle path)."""
         entries = {}
         for cid, channel_summary in summary["channels"].items():
-            entries["channel:" + cid] = self.put_blob(
-                json.dumps(channel_summary, sort_keys=True).encode()
-            )
+            if is_summary_handle(channel_summary):
+                entries["channel:" + cid] = channel_summary[
+                    SUMMARY_HANDLE_KEY
+                ]
+            else:
+                entries["channel:" + cid] = self._put_channel_blob(
+                    json.dumps(channel_summary, sort_keys=True).encode()
+                )
         entries["meta"] = self.put_blob(
             json.dumps(
                 {k: v for k, v in summary.items() if k != "channels"},
@@ -106,8 +158,17 @@ class SummaryStore:
         entries = self.get_tree(handle)
         out = json.loads(self.get_blob(entries["meta"]))
         out["channels"] = {
-            name[len("channel:"):]: json.loads(self.get_blob(h))
+            name[len("channel:"):]: json.loads(self._get_channel_blob(h))
             for name, h in entries.items()
             if name.startswith("channel:")
         }
         return out
+
+    def channel_blob_handles(self, handle: str) -> Dict[str, str]:
+        """cid -> blob handle for each channel of a stored summary (what an
+        incremental summarizer reuses for unchanged channels)."""
+        return {
+            name[len("channel:"):]: h
+            for name, h in self.get_tree(handle).items()
+            if name.startswith("channel:")
+        }

@@ -161,3 +161,171 @@ def test_service_summaries_reconstruct_stream():
         m.sequence_number for m in want
     ]
     assert [m.contents for m in recon] == [m.contents for m in want]
+
+
+class _CountingBackend:
+    """Blob backend instrumented with uploaded-byte accounting."""
+
+    def __init__(self):
+        import hashlib
+
+        self._h = hashlib
+        self._blobs = {}
+        self.bytes_put = 0
+
+    def put_blob(self, data: bytes) -> str:
+        h = self._h.sha256(data).hexdigest()
+        if h not in self._blobs:
+            self.bytes_put += len(data)
+        self._blobs[h] = data
+        return h
+
+    def get_blob(self, handle: str) -> bytes:
+        return self._blobs[handle]
+
+    def has(self, handle: str) -> bool:
+        return handle in self._blobs
+
+
+def test_idle_channel_uploads_o1_handle_bytes():
+    # VERDICT r1 #8 "Done": summary bytes for an idle channel ~ O(1).
+    backend = _CountingBackend()
+    svc = LocalFluidService(store=SummaryStore(backend=backend))
+    a = ContainerRuntime(svc, "doc", channels=channels())
+    a.get_channel("text").insert_text(0, "long stable content " * 500)
+    a.get_channel("meta").set("k", 1)
+    drain([a])
+    a.submit_summary()
+    drain([a])  # ack -> incremental baseline
+
+    a.get_channel("meta").set("k", 2)  # the big text channel stays idle
+    drain([a])
+    before = backend.bytes_put
+    h2 = a.submit_summary()
+    drain([a])
+    delta = backend.bytes_put - before
+    # The 10KB text channel re-uploaded nothing; only the small map blob,
+    # meta blob, and tree blob are new.
+    assert delta < 2_000, f"second summary uploaded {delta} bytes"
+    # And the tree's text entry is the previous blob, byte-identical load.
+    b = ContainerRuntime(svc, "doc", channels=channels())
+    assert (
+        b.get_channel("text").get_text()
+        == a.get_channel("text").get_text()
+    )
+    assert b.get_channel("meta").get("k") == 2
+
+
+def test_incremental_handle_roundtrips_through_load():
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "doc", channels=channels())
+    a.get_channel("text").insert_text(0, "alpha")
+    drain([a])
+    a.submit_summary()
+    drain([a])
+    a.get_channel("meta").set("m", "x")
+    drain([a])
+    h2 = a.submit_summary()
+    drain([a])
+    summary = svc.store.get_summary(h2)
+    # Handles resolve transparently at load: full channel content back.
+    assert summary["channels"]["text"]["payloads"]
+    b = ContainerRuntime(svc, "doc", channels=channels())
+    assert b.get_channel("text").get_text() == "alpha"
+    assert b.get_channel("meta").get("m") == "x"
+
+
+def test_chunked_channel_blob_roundtrip():
+    # Oversized channel bodies split into bounded chunk blobs
+    # (snapshotChunks.ts analog) and reassemble on load.
+    store = SummaryStore(chunk_bytes=512)
+    svc = LocalFluidService(store=store)
+    a = ContainerRuntime(svc, "doc", channels=channels())
+    a.get_channel("text").insert_text(0, "chunky " * 400)  # ~2.8KB body
+    drain([a])
+    h = a.submit_summary()
+    drain([a])
+    tree = store.get_tree(h)
+    body = store.get_blob(tree["channel:text"])
+    assert body.startswith(b"chunks:")  # stored chunked
+    b = ContainerRuntime(svc, "doc", channels=channels())
+    assert b.get_channel("text").get_text() == "chunky " * 400
+
+
+def test_mixed_changed_and_idle_channels_in_one_summary():
+    # A channel changed only ABOVE the acked head must re-upload; one
+    # changed below it must not — mixed case in one summary.
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "doc", channels=channels())
+    a.get_channel("text").insert_text(0, "base")
+    a.get_channel("meta").set("k", 1)
+    a.get_channel("list").insert_nodes(0, [1, 2])
+    drain([a])
+    h1 = a.submit_summary()
+    drain([a])
+    a.get_channel("list").insert_nodes(2, [3])  # only the tree changes
+    drain([a])
+    h2 = a.submit_summary()
+    drain([a])
+    t2 = svc.store.get_tree(h2)
+    h1_blobs = svc.store.channel_blob_handles(h1)
+    # text and meta reused the acked blobs; list got a fresh one.
+    assert t2["channel:text"] == h1_blobs["text"]
+    assert t2["channel:meta"] == h1_blobs["meta"]
+    assert t2["channel:list"] != h1_blobs["list"]
+
+
+def test_swept_channel_not_resurrected_by_handle_reuse():
+    # A channel swept by GC after the acked baseline must be ABSENT from
+    # the next summary — the incremental substitution must not resurrect
+    # it through its old blob handle.
+    from fluidframework_tpu.runtime.gc import GCOptions
+
+    clock = [0.0]
+    opts = GCOptions(
+        inactive_timeout_s=10, tombstone_timeout_s=20, sweep_grace_s=5,
+        sweep_enabled=True, clock=lambda: clock[0],
+    )
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "doc", channels=channels(), gc_options=opts)
+    a.register_channel_type("map", SharedMap)
+    side = a.attach_channel(SharedMap("side"), "map", root=False)
+    side.set("x", 1)
+    a.get_channel("meta").set("ref", a.handle_for("side"))
+    drain([a])
+    h1 = a.submit_summary()
+    drain([a])
+    assert "side" in svc.store.get_summary(h1)["channels"]
+    a.get_channel("meta").delete("ref")  # unreference
+    drain([a])
+    a.run_gc()  # first observation starts the clock
+    clock[0] += 100  # past tombstone + grace
+    h2 = a.submit_summary()
+    drain([a])
+    ch2 = svc.store.get_summary(h2)["channels"]
+    assert "side" not in ch2  # swept, not resurrected via the old handle
+    assert "meta" in ch2
+
+
+def test_file_capture_copies_chunk_blobs():
+    import tempfile
+
+    from fluidframework_tpu.drivers.file_driver import (
+        FileDocumentService,
+        save_document,
+    )
+
+    store = SummaryStore(chunk_bytes=512)
+    svc = LocalFluidService(store=store)
+    a = ContainerRuntime(svc, "doc", channels=channels())
+    a.get_channel("text").insert_text(0, "chunky " * 400)
+    drain([a])
+    a.submit_summary()
+    drain([a])
+    with tempfile.TemporaryDirectory() as d:
+        save_document(svc, "doc", d)
+        fds = FileDocumentService(d, doc_id="doc")
+        b = ContainerRuntime(
+            fds.as_replay_service(), "doc", channels=channels(), mode="read"
+        )
+        assert b.get_channel("text").get_text() == "chunky " * 400
