@@ -333,58 +333,75 @@ def config5_deli_scribe_e2e(n_docs: int, ops_per_doc: int, on_tpu: bool) -> None
     from fluidframework_tpu.ops import encode as E
     from fluidframework_tpu.ops.segment_state import make_batched_state
     from fluidframework_tpu.protocol.constants import NO_CLIENT, OP_WIDTH
-    from fluidframework_tpu.protocol.types import DocumentMessage, MessageType
-    from fluidframework_tpu.service.sequencer import DocumentSequencer
     from fluidframework_tpu.service.summary_store import SummaryStore
+
+    from fluidframework_tpu.protocol.constants import (
+        F_ARG,
+        F_CLIENT,
+        F_LEN,
+        F_MSN,
+        F_POS1,
+        F_POS2,
+        F_REF,
+        F_SEQ,
+        F_TYPE,
+        OP_INSERT,
+        OP_REMOVE,
+    )
+    from fluidframework_tpu.service.fleet_sequencer import FleetSequencer
 
     rng = np.random.default_rng(0)
     rounds = 3
-    sequencers = [DocumentSequencer(f"doc{d}") for d in range(n_docs)]
-    clients = [s.join().contents["clientId"] for s in sequencers]
-    lengths = [0] * n_docs
+    fseq = FleetSequencer(n_docs)
+    joins = fseq.join_all(slot=0)
+    host_backend = "native-c++" if fseq.native_available else "python"
+    lengths = np.zeros(n_docs, np.int64)
+    cseqs = np.zeros(n_docs, np.int64)
     store = SummaryStore()
     summary_writes = 0
 
     def sequence_round() -> np.ndarray:
-        """Host stage: one real deli ticket loop per document. Each round
-        closes with a whole-doc remove + window advance so the device
-        tables stay bounded (steady state)."""
-        batches = np.zeros((n_docs, ops_per_doc, OP_WIDTH), np.int32)
-        rolls = rng.random((n_docs, ops_per_doc))
-        pos_rolls = rng.random((n_docs, ops_per_doc))  # uniform positions
-        for d in range(n_docs):
-            seqr, client = sequencers[d], clients[d]
-            for i in range(ops_per_doc):
-                msg = seqr.ticket(
-                    client,
-                    DocumentMessage(
-                        client_sequence_number=seqr.clients[client].client_seq
-                        + 1,
-                        reference_sequence_number=seqr.seq,
-                        type=MessageType.OPERATION,
-                        contents=None,
-                    ),
+        """Host stage: real deli ticketing for EVERY document through the
+        native batch ticket loop (ticket_loop.cpp; Python fallback keeps
+        identical semantics), content generation vectorized across the
+        fleet. Each round closes with a whole-doc remove + window advance
+        so the device tables stay bounded (steady state)."""
+        k = ops_per_doc
+        batches = np.zeros((n_docs, k, OP_WIDTH), np.int32)
+        intents = np.zeros((n_docs, k, 3), np.int32)
+        start_seq = fseq.doc_state[:, 0].astype(np.int64)
+        for i in range(k):
+            cseqs[:] += 1
+            intents[:, i, 0] = 0  # writer slot
+            intents[:, i, 1] = cseqs
+            intents[:, i, 2] = start_seq + i  # caught-up perspective
+            if i == k - 1:
+                batches[:, i, F_TYPE] = OP_REMOVE
+                batches[:, i, F_POS1] = 0
+                batches[:, i, F_POS2] = lengths
+                lengths[:] = 0
+            else:
+                roll = rng.random(n_docs)
+                pos = rng.random(n_docs)
+                rem = (lengths >= 6) & (roll < 0.4)
+                a = (pos * np.maximum(lengths - 2, 1)).astype(np.int64)
+                batches[:, i, F_TYPE] = np.where(rem, OP_REMOVE, OP_INSERT)
+                batches[:, i, F_POS1] = np.where(
+                    rem, a, (pos * (lengths + 1)).astype(np.int64)
                 )
-                s = msg.sequence_number
-                if i == ops_per_doc - 1:
-                    batches[d, i] = E.remove(
-                        0, lengths[d], seq=s, ref=s - 1, client=client, msn=s
-                    )
-                    lengths[d] = 0
-                elif lengths[d] >= 6 and rolls[d, i] < 0.4:
-                    a = int(pos_rolls[d, i] * (lengths[d] - 2))
-                    batches[d, i] = E.remove(
-                        a, a + 2, seq=s, ref=s - 1, client=client,
-                        msn=msg.minimum_sequence_number,
-                    )
-                    lengths[d] -= 2
-                else:
-                    batches[d, i] = E.insert(
-                        int(pos_rolls[d, i] * (lengths[d] + 1)), 10 + i, 3,
-                        seq=s, ref=s - 1, client=client,
-                        msn=msg.minimum_sequence_number,
-                    )
-                    lengths[d] += 3
+                batches[:, i, F_POS2] = np.where(rem, a + 2, 0)
+                batches[:, i, F_ARG] = np.where(rem, 0, 10 + i)
+                batches[:, i, F_LEN] = np.where(rem, 0, 3)
+                lengths[:] += np.where(rem, -2, 3)
+        out, err = fseq.ticket_batch(intents)
+        assert not err.any(), "steady-state stream must stay on the fast path"
+        batches[:, :, F_SEQ] = out[:, :, 0]
+        batches[:, :, F_REF] = out[:, :, 0] - 1
+        batches[:, :, F_MSN] = out[:, :, 1]
+        batches[:, :, F_CLIENT] = 0
+        # Close the collab window on the round's last op so compaction
+        # reclaims the emptied tables (zamboni steady state).
+        batches[:, k - 1, F_MSN] = batches[:, k - 1, F_SEQ]
         return batches
 
     def scribe_round(r: int, batches: np.ndarray) -> int:
@@ -394,7 +411,7 @@ def config5_deli_scribe_e2e(n_docs: int, ops_per_doc: int, on_tpu: bool) -> None
         for d in range(r, n_docs, rounds):
             store.put_blob(
                 json.dumps(
-                    {"doc": f"doc{d}", "head": int(sequencers[d].seq)}
+                    {"doc": f"doc{d}", "head": int(fseq.doc_state[d, 0])}
                 ).encode()
                 + batches[d].tobytes()
             )
@@ -461,8 +478,97 @@ def config5_deli_scribe_e2e(n_docs: int, ops_per_doc: int, on_tpu: bool) -> None
         host_stage_s=round(t_seq + t_scribe, 3),
         host_seq_s=round(t_seq, 3), scribe_s=round(t_scribe, 3),
         host_tickets_per_sec=round(total / t_seq),
+        host_backend=host_backend,
         summary_writes=summary_writes,
         device_step_ms=round(device_step_ms, 3), errs=errs,
+    )
+
+
+def config6_big_docs(n_docs: int, target_rows: int, on_tpu: bool) -> None:
+    """Throughput at REALISTIC document sizes (VERDICT r1 Weak #5): every
+    round-1 bench ended rounds with a whole-doc remove, so steady-state
+    tables held ≲64 tiny rows. Here documents GROW through the fleet's
+    capacity lifecycle (pool promotion, zero drops) to ``target_rows``
+    live rows each, then the timed phase measures apply+compact at that
+    size with a balanced insert/remove mix. 16 distinct op scripts tiled
+    across the fleet (device timing is shape-dependent, not
+    data-dependent)."""
+    from fluidframework_tpu.ops import encode as E
+    from fluidframework_tpu.parallel.fleet import DocFleet
+    from fluidframework_tpu.protocol.constants import OP_WIDTH
+
+    rng = np.random.default_rng(0)
+    scripts = min(16, n_docs)
+    k = 32
+    fleet = DocFleet(n_docs=n_docs, capacity=256, high_water=0.7)
+    seqs = [0] * scripts
+    lens = [0] * scripts
+
+    def round_ops(grow: bool) -> np.ndarray:
+        ops = np.zeros((n_docs, k, OP_WIDTH), np.int32)
+        for d in range(scripts):
+            for i in range(k):
+                seqs[d] += 1
+                remove = (
+                    lens[d] > 8
+                    and rng.random() < (0.05 if grow else 0.5)
+                )
+                if remove:
+                    a = int(rng.integers(0, lens[d] - 4))
+                    ops[d, i] = E.remove(
+                        a, a + 4, seq=seqs[d], ref=seqs[d] - 1,
+                        client=int(rng.integers(0, 8)),
+                        msn=max(0, seqs[d] - 64),
+                    )
+                    lens[d] -= 4
+                else:
+                    ops[d, i] = E.insert(
+                        int(rng.integers(0, lens[d] + 1)), 10 + seqs[d], 4,
+                        seq=seqs[d], ref=seqs[d] - 1,
+                        client=int(rng.integers(0, 8)),
+                        msn=max(0, seqs[d] - 64),
+                    )
+                    lens[d] += 4
+        for d in range(scripts, n_docs):
+            ops[d] = ops[d % scripts]
+        return ops
+
+    # Growth phase (untimed): drive docs to the target size through the
+    # promotion lifecycle.
+    while True:
+        fleet.apply(round_ops(grow=True))
+        fleet.compact()
+        fleet.check_and_migrate()
+        counts = [
+            int(np.asarray(fleet.doc_state(d).count)) for d in range(scripts)
+        ]
+        if min(counts) >= target_rows:
+            break
+    stats = fleet.stats()
+    assert stats["docs_with_errors"] == 0, stats
+
+    # Warmup to promotion quiescence: steady-state rounds until no doc
+    # promotes (each new pool shape compiles once, outside the timed loop).
+    for _ in range(12):
+        fleet.apply(round_ops(grow=False))
+        fleet.compact()
+        if not fleet.check_and_migrate():
+            break
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fleet.apply(round_ops(grow=False))
+        fleet.compact()
+        fleet.check_and_migrate()
+    stats = fleet.stats()
+    assert stats["docs_with_errors"] == 0, stats
+    dt = time.perf_counter() - t0
+    rows_now = stats["rows_in_use"] // n_docs
+    _emit(
+        metric="big_doc_ops_per_sec", value=round(n_docs * k * iters / dt),
+        unit="ops/s", config=6, n_docs=n_docs,
+        live_rows_per_doc=rows_now, capacity_tiers=stats["pools"],
+        migrations=stats["migrations"], errs=stats["docs_with_errors"],
     )
 
 
@@ -508,6 +614,12 @@ def main() -> None:
         config5_deli_scribe_e2e(
             n_docs=100_000 if full else 64,
             ops_per_doc=16 if full else 8,
+            on_tpu=on_tpu,
+        )
+    if args.config in (0, 6):
+        config6_big_docs(
+            n_docs=256 if full else 8,
+            target_rows=4096 if full else 256,
             on_tpu=on_tpu,
         )
 

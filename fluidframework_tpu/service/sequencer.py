@@ -32,6 +32,9 @@ from fluidframework_tpu.protocol.types import (
 )
 
 
+FULL_SCOPES = ("doc:read", "doc:write", "summary:write")
+
+
 @dataclass
 class _ClientEntry:
     client_id: int
@@ -40,6 +43,7 @@ class _ClientEntry:
     can_evict: bool = True
     mode: str = "write"
     last_seen: float = 0.0  # wall time of last op/join (idle expiry)
+    scopes: tuple = FULL_SCOPES  # token claims (reference scopes.ts)
 
 
 @dataclass
@@ -62,6 +66,12 @@ class DocumentSequencer:
         self.doc_id = doc_id
         self.seq = 0
         self.min_seq = 0
+        # Control plane (reference deli lambda.ts:989+ ControlMessageType):
+        # durable sequence number (UpdateDSN — the log-truncation floor) and
+        # maintenance nacking (NackMessages).
+        self.durable_seq = 0
+        self._nack_all: Optional[dict] = None  # {"code", "message"}
+        self._no_client_emitted = True  # fresh doc has no clients
         self.clients: Dict[int, _ClientEntry] = {}
         self._next_slot = 0
         # Slots released by leaves, reusable once their leave seq falls at or
@@ -85,7 +95,9 @@ class DocumentSequencer:
 
     # -- session management --------------------------------------------------
 
-    def join(self, mode: str = "write") -> Union[SequencedDocumentMessage, NackMessage]:
+    def join(
+        self, mode: str = "write", scopes: tuple = FULL_SCOPES
+    ) -> Union[SequencedDocumentMessage, NackMessage]:
         """Admit a client; returns the sequenced ClientJoin op.
 
         The slot cap mirrors the kernel's removers bitmask width: deli's
@@ -110,6 +122,7 @@ class DocumentSequencer:
         # IClient payload) — election needs the mode for eligibility, and
         # connNo is the never-recycled ordinal content ids scope to.
         self._conn_count += 1
+        self._no_client_emitted = False
         msg = self._sequence_system(
             MessageType.CLIENT_JOIN,
             contents={"clientId": slot, "mode": mode, "connNo": self._conn_count},
@@ -117,7 +130,7 @@ class DocumentSequencer:
         # The new client's collab-window floor is the join op itself.
         self.clients[slot] = _ClientEntry(
             client_id=slot, ref_seq=msg.sequence_number, client_seq=0, mode=mode,
-            last_seen=time.time(),
+            last_seen=time.time(), scopes=tuple(scopes),
         )
         return msg
 
@@ -128,6 +141,41 @@ class DocumentSequencer:
         msg = self._sequence_system(MessageType.CLIENT_LEAVE, contents=client_id)
         self._free_slots.append([client_id, msg.sequence_number])
         return msg
+
+    def maybe_no_client(self) -> Optional[SequencedDocumentMessage]:
+        """Emit a NoClient system op once when the last client departs
+        (reference deli op-events, lambda.ts:136-150) — the service's
+        trigger for an end-of-session service summary."""
+        if self.clients or self._no_client_emitted:
+            return None
+        self._no_client_emitted = True
+        return self._sequence_system(MessageType.NO_CLIENT, contents=None)
+
+    # -- control plane (reference ControlMessageType, deli lambda.ts:989+) ---
+
+    def control(self, contents: dict) -> SequencedDocumentMessage:
+        """Apply a sequenced service control message.
+
+        - ``{"type": "updateDSN", "dsn": N}`` advances the durable sequence
+          number (the storage-confirmed floor log truncation may use);
+        - ``{"type": "nackMessages", "enable": bool, "code"?, "message"?}``
+          toggles maintenance mode: while enabled every client op is nacked
+          with the given code (the reference's NackMessages control).
+        """
+        kind = contents.get("type")
+        if kind == "updateDSN":
+            self.durable_seq = max(self.durable_seq, int(contents["dsn"]))
+        elif kind == "nackMessages":
+            if contents.get("enable", True):
+                self._nack_all = {
+                    "code": int(contents.get("code", 503)),
+                    "message": contents.get("message", "service paused"),
+                }
+            else:
+                self._nack_all = None
+        else:
+            raise ValueError(f"unknown control message {kind!r}")
+        return self._sequence_system(MessageType.CONTROL, contents=contents)
 
     def expire_idle(
         self, timeout_s: float, now: Optional[float] = None
@@ -165,6 +213,15 @@ class DocumentSequencer:
             return NackMessage(
                 self.seq, 403, NackErrorType.INVALID_SCOPE, "read-only client"
             )
+        if self._nack_all is not None:
+            # Maintenance mode (NackMessages control): reject without
+            # consuming the clientSequenceNumber so a later resubmit works.
+            return NackMessage(
+                self.seq, self._nack_all["code"],
+                NackErrorType.LIMIT_EXCEEDED, self._nack_all["message"],
+                retry_after_s=1.0,
+                client_sequence_number=msg.client_sequence_number,
+            )
         # Duplicate: clientSequenceNumber at-or-below the highest seen.
         if msg.client_sequence_number <= entry.client_seq:
             return None
@@ -180,6 +237,16 @@ class DocumentSequencer:
             return NackMessage(
                 self.seq, 400, NackErrorType.BAD_REQUEST,
                 f"refSeq {msg.reference_sequence_number} below MSN {self.min_seq}",
+                client_sequence_number=msg.client_sequence_number,
+            )
+        if (
+            msg.type == MessageType.SUMMARIZE
+            and "summary:write" not in entry.scopes
+        ):
+            # Unauthorized Summarize -> 403 (reference deli lambda.ts:884-893).
+            return NackMessage(
+                self.seq, 403, NackErrorType.INVALID_SCOPE,
+                "client token lacks summary:write",
                 client_sequence_number=msg.client_sequence_number,
             )
         entry.client_seq = msg.client_sequence_number

@@ -198,3 +198,58 @@ class NativeCoordination:
 
 def native_coordination_available() -> bool:
     return _load_coord() is not None
+
+
+# -- batch deli ticket loop (native/ticket_loop.cpp) -------------------------
+
+_ticket_registered = False
+
+
+def _load_ticket():
+    global _ticket_registered
+    lib = _load_lib("libticket.so")
+    if lib is not None and not _ticket_registered:
+        lib.ticket_batch.restype = ctypes.c_int32
+        lib.ticket_batch.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        _ticket_registered = True
+    return lib
+
+
+class NativeTicketLoop:
+    """Fleet-wide deli ticketing in C++ (the steady-state write-client
+    fast path; see native/ticket_loop.cpp for the contract). Documents
+    flagged in ``err`` must replay through the Python DocumentSequencer
+    slow path (which owns nacks/joins/controls)."""
+
+    def __init__(self):
+        self._lib = _load_ticket()
+
+    @property
+    def available(self) -> bool:
+        return self._lib is not None
+
+    def ticket_batch(self, doc_state, clients, ops, out, err) -> int:
+        """All arrays C-contiguous int32 numpy, shapes per ticket_loop.cpp.
+        Returns the number of documents that need the slow path."""
+        import numpy as np
+
+        n_docs, k, _ = ops.shape
+        max_writers = clients.shape[1]
+        for a in (doc_state, clients, out, err):
+            assert a.dtype == np.int32 and a.flags.c_contiguous
+        assert ops.dtype == np.int32 and ops.flags.c_contiguous
+        return int(
+            self._lib.ticket_batch(
+                n_docs, k, max_writers,
+                doc_state.ctypes.data, clients.ctypes.data,
+                ops.ctypes.data, out.ctypes.data, err.ctypes.data,
+            )
+        )
+
+
+def native_ticket_available() -> bool:
+    return _load_ticket() is not None

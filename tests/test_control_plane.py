@@ -1,0 +1,86 @@
+"""Deli control plane (reference deli/lambda.ts:989+ control messages,
+:884-893 unauthorized-Summarize nack, :136-150 op-events)."""
+
+import pytest
+
+from fluidframework_tpu.models.shared_map import SharedMap
+from fluidframework_tpu.protocol.types import MessageType, NackMessage
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service.local_server import LocalFluidService
+from fluidframework_tpu.service.sequencer import DocumentSequencer
+
+
+def drain(rts):
+    busy = True
+    while busy:
+        busy = any(rt.process_incoming() for rt in rts if rt.connected)
+
+
+def test_unauthorized_summarize_gets_403():
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "doc", channels=(SharedMap("m"),))
+    # A second writer whose token lacks the summary scope.
+    conn = svc.connect("doc", "write", scopes=("doc:read", "doc:write"))
+    from fluidframework_tpu.protocol.types import DocumentMessage
+
+    conn.submit(
+        DocumentMessage(
+            client_sequence_number=1,
+            reference_sequence_number=conn.join_seq,
+            type=MessageType.SUMMARIZE,
+            contents={"handle": "x", "head": 1},
+        )
+    )
+    assert conn.nacks and conn.nacks[0].content_code == 403
+    # The authorized client still summarizes fine.
+    a.get_channel("m").set("k", 1)
+    drain([a])
+    a.submit_summary()
+    drain([a])
+    assert svc.docs["doc"].latest_summary is not None
+
+
+def test_update_dsn_advances_durable_floor():
+    s = DocumentSequencer("d")
+    s.join()
+    msg = s.control({"type": "updateDSN", "dsn": 7})
+    assert msg.type == MessageType.CONTROL
+    assert s.durable_seq == 7
+    s.control({"type": "updateDSN", "dsn": 3})  # never regresses
+    assert s.durable_seq == 7
+
+
+def test_nack_messages_maintenance_mode():
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "doc", channels=(SharedMap("m"),))
+    b = ContainerRuntime(svc, "doc", channels=(SharedMap("m"),))
+    a.get_channel("m").set("k", 1)
+    drain([a, b])
+
+    svc.control("doc", {"type": "nackMessages", "enable": True, "code": 503})
+    a.get_channel("m").set("k", 2)
+    a.flush()
+    a.process_incoming()  # 503 -> ops park offline, connection drops
+    assert not a.connected
+    assert a.get_channel("m").get("k") == 2  # optimistic view kept
+
+    svc.control("doc", {"type": "nackMessages", "enable": False})
+    a.reconnect()
+    drain([a, b])
+    assert a.get_channel("m").get("k") == b.get_channel("m").get("k") == 2
+
+
+def test_no_client_triggers_service_summary():
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "doc", channels=(SharedMap("m"),))
+    a.get_channel("m").set("k", 1)
+    drain([a])
+    assert not svc.docs["doc"].service_summaries
+    a.disconnect()  # last client out -> NoClient + end-of-session summary
+    doc = svc.docs["doc"]
+    assert doc.op_log[-1].type == MessageType.NO_CLIENT
+    assert doc.service_summaries, "NoClient must trigger a service summary"
+    # Re-join resets the trigger: next full departure emits again.
+    b = ContainerRuntime(svc, "doc", channels=(SharedMap("m"),))
+    b.disconnect()
+    assert sum(1 for m in doc.op_log if m.type == MessageType.NO_CLIENT) == 2
