@@ -81,7 +81,7 @@ def config2b_apply_latency(n_docs: int, k: int, steps: int, on_tpu: bool) -> Non
     import jax
 
     from bench import build_op_stream
-    from fluidframework_tpu.ops.pallas_compact import compact_packed
+    from fluidframework_tpu.ops.pallas_compact import apply_compact_packed
     from fluidframework_tpu.ops.pallas_kernel import (
         SC_ERR,
         apply_ops_packed,
@@ -94,29 +94,44 @@ def config2b_apply_latency(n_docs: int, k: int, steps: int, on_tpu: bool) -> Non
     ops = jax.device_put(build_op_stream(n_docs, k, rng))
     blk = 32 if on_tpu else 8
     tables, scalars = pack_state(make_batched_state(n_docs, 256, NO_CLIENT))
+    # Warm BOTH kernels (plain apply and fused apply+compact) so no JIT
+    # compile lands inside the timed loop.
     tables, scalars = apply_ops_packed(
         tables, scalars, ops, block_docs=blk, interpret=not on_tpu
     )
-    tables, scalars = compact_packed(tables, scalars, interpret=not on_tpu)
+    tables, scalars = apply_compact_packed(
+        tables, scalars, ops, block_docs=blk, interpret=not on_tpu
+    )
     np.asarray(scalars[:, SC_ERR])
 
     times = []
     for i in range(steps):
         t0 = time.perf_counter()
-        tables, scalars = apply_ops_packed(
-            tables, scalars, ops, block_docs=blk, interpret=not on_tpu
-        )
-        if i % 4 == 3:  # zamboni amortizes across small batches
-            tables, scalars = compact_packed(
-                tables, scalars, interpret=not on_tpu
+        if i % 4 == 3:
+            # Zamboni cadence: the FUSED apply+compact replaces what used
+            # to be two dispatches — the p99 step (VERDICT r1 #10).
+            tables, scalars = apply_compact_packed(
+                tables, scalars, ops, block_docs=blk, interpret=not on_tpu
+            )
+        else:
+            tables, scalars = apply_ops_packed(
+                tables, scalars, ops, block_docs=blk, interpret=not on_tpu
             )
         np.asarray(scalars[:, SC_ERR])
         times.append(time.perf_counter() - t0)
     assert int(np.asarray(scalars[:, SC_ERR]).sum()) == 0
     arr = np.array(times) * 1e3
+    fused_steps = arr[3::4]  # the zamboni-cadence (apply+compact) steps
+    plain_steps = np.delete(arr, np.s_[3::4])
+
+    def _med(x):  # empty slice (smoke runs) -> null, not NaN-invalid JSON
+        return round(float(np.median(x)), 3) if len(x) else None
+
     _emit(
         metric="apply_step_latency_ms", value=round(float(np.median(arr)), 3),
         unit="ms", config="2b", p99_ms=round(float(np.percentile(arr, 99)), 3),
+        apply_step_median_ms=_med(plain_steps),
+        fused_zamboni_step_median_ms=_med(fused_steps),
         n_docs=n_docs, ops_per_doc=k,
         ops_per_sec=round(n_docs * k * len(times) / (arr.sum() / 1e3)),
     )

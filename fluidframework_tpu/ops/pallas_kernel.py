@@ -97,7 +97,10 @@ def _excl_cumsum(x: jnp.ndarray) -> jnp.ndarray:
     return y - x
 
 
-def _kernel(ops_ref, tables_ref, scalars_ref, otables_ref, oscalars_ref):
+def _apply_values(ops_ref, tables_ref, scalars_ref):
+    """The op-application body on VALUES: returns (lanes, count, min_seq,
+    cur_seq, self_client, err) so the standalone kernel and the fused
+    apply+compact kernel (pallas_compact.apply_compact_packed) share it."""
     k_total = ops_ref.shape[0]
     b, s = tables_ref.shape[1], tables_ref.shape[2]
     col = jax.lax.broadcasted_iota(_I32, (b, s), 1)
@@ -321,10 +324,15 @@ def _kernel(ops_ref, tables_ref, scalars_ref, otables_ref, oscalars_ref):
     self0 = scalars_ref[:, SC_SELF : SC_SELF + 1]
     err0 = scalars_ref[:, SC_ERR : SC_ERR + 1]
 
-    lanes, count, min_seq, cur_seq, self_client, err = jax.lax.fori_loop(
+    return jax.lax.fori_loop(
         0, k_total, step, (lanes0, count0, min_seq0, cur_seq0, self0, err0)
     )
 
+
+def _kernel(ops_ref, tables_ref, scalars_ref, otables_ref, oscalars_ref):
+    lanes, count, min_seq, cur_seq, self_client, err = _apply_values(
+        ops_ref, tables_ref, scalars_ref
+    )
     for i in range(N_LANES):
         otables_ref[i] = lanes[i]
     zpad = jnp.zeros((count.shape[0], N_SCALARS - 5), _I32)
