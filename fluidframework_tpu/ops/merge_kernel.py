@@ -174,19 +174,28 @@ def _bookkeep(state: SegmentState, op: jnp.ndarray) -> SegmentState:
 # ---------------------------------------------------------------------------
 
 
+def insert_place_mask(state: SegmentState, op, part, vis, rem):
+    """Rows the insert may land before (insertingWalk + breakTie,
+    mergeTree.ts:1740/1719). Shared with the sharded-document owner
+    resolution (parallel/sharded_doc.py) — the tie-break rule must never
+    de-synchronize between ownership and the owner's actual insert."""
+    op_norm = jnp.where(op[F_SEQ] == UNASSIGNED_SEQ, NORM_NEW_LOCAL, op[F_SEQ])
+    seg_norm = jnp.where(
+        state.seq == UNASSIGNED_SEQ, NORM_EXISTING_LOCAL, state.seq
+    )
+    return part & (
+        ((vis > 0) & (rem >= 0) & (rem < vis))
+        | ((vis == 0) & (rem == 0) & (op_norm > seg_norm))
+    )
+
+
 def _apply_insert(state: SegmentState, op: jnp.ndarray) -> SegmentState:
     cap = state.kind.shape[-1]
     is_local = op[F_CLIENT] == state.self_client
     part, vis = perspective(state, op[F_REF], op[F_CLIENT], is_local)
     prefix = _excl_cumsum(vis)
     rem = op[F_POS1] - prefix
-
-    op_norm = jnp.where(op[F_SEQ] == UNASSIGNED_SEQ, NORM_NEW_LOCAL, op[F_SEQ])
-    seg_norm = jnp.where(state.seq == UNASSIGNED_SEQ, NORM_EXISTING_LOCAL, state.seq)
-    place = part & (
-        ((vis > 0) & (rem >= 0) & (rem < vis))
-        | ((vis == 0) & (rem == 0) & (op_norm > seg_norm))
-    )
+    place = insert_place_mask(state, op, part, vis, rem)
     has, idx = _first_true(place)
     total = jnp.sum(vis)
     idx = jnp.where(has, idx, state.count)

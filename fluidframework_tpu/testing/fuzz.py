@@ -23,6 +23,7 @@ def random_acked_stream(
     track: OracleDoc,
     msn_lag: int | None = None,
     caught_up: bool = False,
+    seq0: int = 1,
 ):
     """Valid fully-acked sequenced ops, evolving alongside an oracle.
 
@@ -38,7 +39,7 @@ def random_acked_stream(
     """
     ops = []
     next_orig = len(payloads) + 1
-    for seq in range(1, n_ops + 1):
+    for seq in range(seq0, seq0 + n_ops):
         msn = max(0, seq - msn_lag) if msn_lag is not None else 0
         length = len(track.text(payloads))
         kind = int(rng.integers(0, 3)) if length > 0 else 0
