@@ -50,22 +50,32 @@ class PersistentCache:
         return hashlib.sha256(key.encode()).hexdigest()
 
     def _doc_path(self, doc_id: str) -> str:
-        return os.path.join(self.dir, f"doc-{self._fs_name(doc_id)}.json")
+        return os.path.join(self.dir, f"doc-{self._fs_name(doc_id)}.snap")
 
     def get_doc(self, doc_id: str) -> Optional[dict]:
         if doc_id in self._docs:
             return self._docs[doc_id]
         if self.dir and os.path.exists(self._doc_path(doc_id)):
-            with open(self._doc_path(doc_id)) as f:
-                self._docs[doc_id] = json.load(f)
+            from fluidframework_tpu.drivers.binary_snapshot import (
+                decode_snapshot,
+            )
+
+            with open(self._doc_path(doc_id), "rb") as f:
+                self._docs[doc_id] = decode_snapshot(f.read())
             return self._docs[doc_id]
         return None
 
     def put_doc(self, doc_id: str, entry: dict) -> None:
         self._docs[doc_id] = entry
         if self.dir:
-            with open(self._doc_path(doc_id), "w") as f:
-                json.dump(entry, f)
+            # Compact binary on disk (the odsp snapshot-format analog) —
+            # cold-start bytes are the cache's whole point.
+            from fluidframework_tpu.drivers.binary_snapshot import (
+                encode_snapshot,
+            )
+
+            with open(self._doc_path(doc_id), "wb") as f:
+                f.write(encode_snapshot(entry))
 
     def evict_doc(self, doc_id: str) -> None:
         self._docs.pop(doc_id, None)

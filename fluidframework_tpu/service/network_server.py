@@ -202,9 +202,14 @@ class FluidNetworkServer:
                 + payload
             )
 
-        # Delta routes are doc-scoped; blob routes use a storage-scope token
-        # (minted for the empty doc id), since handles aren't per-document.
-        scope = parts[1] if len(parts) > 1 and parts[0] == "deltas" else ""
+        # Delta/document routes are doc-scoped; blob routes use a
+        # storage-scope token (minted for the empty doc id), since handles
+        # aren't per-document.
+        scope = (
+            parts[1]
+            if len(parts) > 1 and parts[0] in ("deltas", "documents")
+            else ""
+        )
         if not self._authorized(query, doc_id=scope):
             reply(403, b'{"error": "invalid token"}')
             return
@@ -224,6 +229,53 @@ class FluidNetworkServer:
                 to_seq=int(query["to"]) if "to" in query else None,
             )
             reply(200, json.dumps([to_jsonable(m) for m in msgs]).encode())
+        elif method == "POST" and parts == ["documents"]:
+            # Create (alfred POST /documents, routerlicious-base
+            # alfred/routes/api): allocates the document's service state;
+            # the caller supplies or receives its id.
+            if not hasattr(self.service, "_doc"):
+                reply(501, b'{"error": "documents API unsupported"}')
+                await writer.drain()
+                return
+            try:
+                req = json.loads(body or b"{}")
+            except ValueError:
+                reply(400, b'{"error": "malformed JSON body"}')
+                await writer.drain()
+                return
+            doc_id = req.get("id") or f"doc-{secrets.token_hex(6)}"
+            self.service._doc(doc_id)
+            reply(201, json.dumps({"id": doc_id}).encode())
+        elif method == "GET" and len(parts) == 2 and parts[0] == "documents":
+            # Metadata (alfred GET /documents/:id): existence, head seq,
+            # latest acked summary pointer, connected clients.
+            doc_id = parts[1]
+            if not hasattr(self.service, "docs"):
+                reply(501, b'{"error": "documents API unsupported"}')
+                await writer.drain()
+                return
+            exists = doc_id in self.service.docs
+            if not exists:
+                reply(404, json.dumps({"id": doc_id, "exists": False}).encode())
+            else:
+                doc = self.service.docs[doc_id]
+                reply(
+                    200,
+                    json.dumps(
+                        {
+                            "id": doc_id,
+                            "exists": True,
+                            "head": doc.sequencer.seq,
+                            "minimum_sequence_number": doc.sequencer.min_seq,
+                            "latest_summary": (
+                                list(doc.latest_summary)
+                                if doc.latest_summary
+                                else None
+                            ),
+                            "clients": len(doc.connections),
+                        }
+                    ).encode(),
+                )
         else:
             reply(404, b'{"error": "not found"}')
         await writer.drain()

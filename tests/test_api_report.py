@@ -1,0 +1,26 @@
+"""API-surface lock (SURVEY §2.8 API-Extractor analog): the public surface
+must match the committed report — regenerate with
+`python tools/api_report.py write` when a change is INTENTIONAL."""
+
+import os
+import sys
+
+
+def test_api_surface_matches_report():
+    tools = os.path.join(os.path.dirname(__file__), "..", "tools")
+    sys.path.insert(0, tools)
+    try:
+        import api_report
+    finally:
+        sys.path.remove(tools)
+    report_file = os.path.join(
+        os.path.dirname(__file__), "..", "api-report",
+        "fluidframework_tpu.api.txt",
+    )
+    with open(report_file) as f:
+        want = f.read()
+    got = api_report.public_surface()
+    assert got == want, (
+        "public API surface drifted from api-report/ — regenerate with "
+        "`python tools/api_report.py write` ONLY if the change is intentional"
+    )

@@ -215,3 +215,41 @@ def test_frame_decoder_accepts_normal_traffic_under_cap():
     dec = wsproto.FrameDecoder(max_bytes=1024)
     frames = dec.feed(wsproto.encode_frame(wsproto.OP_TEXT, b"hello", mask=True))
     assert frames == [(wsproto.OP_TEXT, b"hello")]
+
+
+def test_documents_rest_api(server):
+    # Reference alfred REST routes (routerlicious-base alfred/routes/api):
+    # POST /documents creates, GET /documents/:id serves metadata.
+    import json as _json
+    import urllib.request
+
+    host, port = "127.0.0.1", server.port
+    req = urllib.request.Request(
+        f"http://{host}:{port}/documents",
+        data=_json.dumps({"id": "restdoc"}).encode(),
+        method="POST",
+    )
+    with urllib.request.urlopen(req) as r:
+        assert r.status == 201
+        assert _json.loads(r.read())["id"] == "restdoc"
+
+    svc = NetworkFluidService(host, port)
+    rt = ContainerRuntime(svc, "restdoc", channels=(SharedString("t"),))
+    rt.get_channel("t").insert_text(0, "hi")
+    drain_networked([rt])
+    rt.submit_summary()
+    drain_networked([rt])
+
+    with urllib.request.urlopen(
+        f"http://{host}:{port}/documents/restdoc"
+    ) as r:
+        meta = _json.loads(r.read())
+    assert meta["exists"] and meta["head"] >= 2
+    assert meta["latest_summary"] is not None
+    assert meta["clients"] == 1
+
+    try:
+        urllib.request.urlopen(f"http://{host}:{port}/documents/nope")
+        assert False, "404 expected"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404

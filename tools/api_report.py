@@ -1,0 +1,75 @@
+"""API-surface report generator (SURVEY §2.8: the reference locks each
+package's public surface with API-Extractor `.api.md` files; here one
+plaintext report per top-level module, regenerated and diffed by
+tests/test_api_report.py so unreviewed surface drift fails CI).
+
+    python tools/api_report.py            # print to stdout
+    python tools/api_report.py write      # regenerate api-report/
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+import pkgutil
+import sys
+
+REPORT = os.path.join(os.path.dirname(os.path.dirname(__file__)), "api-report")
+
+SKIP_PREFIXES = ("fluidframework_tpu.testing",)  # test utilities, not API
+
+
+def public_surface() -> str:
+    import fluidframework_tpu
+
+    lines = []
+    pkg_path = fluidframework_tpu.__path__
+    names = sorted(
+        m.name
+        for m in pkgutil.walk_packages(pkg_path, "fluidframework_tpu.")
+    )
+    for name in ["fluidframework_tpu"] + names:
+        if name.startswith(SKIP_PREFIXES):
+            continue
+        try:
+            mod = importlib.import_module(name)
+        except Exception as e:  # pragma: no cover - import errors are drift
+            lines.append(f"{name}: IMPORT ERROR {type(e).__name__}")
+            continue
+        symbols = []
+        for attr in sorted(vars(mod)):
+            if attr.startswith("_"):
+                continue
+            obj = getattr(mod, attr)
+            if inspect.ismodule(obj):
+                continue
+            home = getattr(obj, "__module__", name)
+            if isinstance(home, str) and not home.startswith(
+                "fluidframework_tpu"
+            ):
+                continue  # re-exported stdlib/third-party
+            kind = (
+                "class" if inspect.isclass(obj)
+                else "def" if callable(obj)
+                else "const"
+            )
+            symbols.append(f"  {kind} {attr}")
+        lines.append(f"{name}:")
+        lines.extend(symbols)
+    return "\n".join(lines) + "\n"
+
+
+def main() -> None:
+    text = public_surface()
+    if len(sys.argv) > 1 and sys.argv[1] == "write":
+        os.makedirs(REPORT, exist_ok=True)
+        with open(os.path.join(REPORT, "fluidframework_tpu.api.txt"), "w") as f:
+            f.write(text)
+        print("api-report regenerated")
+    else:
+        print(text, end="")
+
+
+if __name__ == "__main__":
+    main()
