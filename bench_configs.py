@@ -232,16 +232,17 @@ def config3b_tree_rebase_device(
     cpu_rate = scripts * n_commits / (time.perf_counter() - t0)
 
     # Warmup / compile.
-    out_ids, out_L = batched_trunk_scan(doc_ids, L0, batch, W)
+    out_ids, out_L, err = batched_trunk_scan(doc_ids, L0, batch, W)
     np.asarray(out_L)
     iters = 3
     t0 = time.perf_counter()
     for _ in range(iters):
-        out_ids, out_L = batched_trunk_scan(doc_ids, L0, batch, W)
+        out_ids, out_L, err = batched_trunk_scan(doc_ids, L0, batch, W)
         np.asarray(out_L)  # forces completion (tunnel-honest)
     dt = time.perf_counter() - t0
     rate = n_docs * n_commits * iters / dt
 
+    assert not np.asarray(err).any(), "ring-window overflow in config 3b"
     for d in range(scripts):  # parity across every distinct script
         got = TK.dense_to_doc(out_ids[d], out_L[d])
         assert got == host_states[d], f"device/host divergence on doc {d}"

@@ -214,11 +214,15 @@ def invert_change(
 
 def compose_change(
     a: DenseChange, b: DenseChange, L: jnp.ndarray
-) -> DenseChange:
+) -> Tuple[DenseChange, jnp.ndarray]:
     """Changeset equivalent to applying ``a`` then ``b`` (b reads a's
     output; the result reads a's input). The merged insert pool is built by
     one sort over (a-output coordinate, source) keys — the dense form of
-    the reference's two-queue co-iteration."""
+    the reference's two-queue co-iteration.
+
+    Returns ``(change, overflow)``: ``overflow`` is 1 when the merged live
+    pool exceeds ``Pc`` and the result truncated (the ERR_CAPACITY analog —
+    callers must treat the composed change as invalid when set)."""
     Lc = a.del_mask.shape[-1]
     Pc = a.ins_ids.shape[-1]
     valid, akeep, af_pos, aDex_b, abcum, aicnt = _prefix(a, L)
@@ -282,7 +286,8 @@ def compose_change(
         jnp.ones(2 * Pc, jnp.int32),
         Lc + 1,
     )
-    return DenseChange(del_mask, ins_cnt, ins_ids)
+    overflow = (n_live > Pc).astype(jnp.int32)
+    return DenseChange(del_mask, ins_cnt, ins_ids), overflow
 
 
 # -- host <-> dense conversion (test/bench plumbing, not the hot path) ------

@@ -55,7 +55,11 @@ def _select(pred, a: DenseChange, b: DenseChange) -> DenseChange:
 
 def trunk_scan(doc_ids, L, commits: CommitBatch, W: int):
     """Integrate C sequenced commits into the trunk; returns the final
-    (doc_ids, L). Ring entries hold (trunk form, input length, seq)."""
+    ``(doc_ids, L, err)``. Ring entries hold (trunk form, input length,
+    seq). ``err`` is sticky and set when a commit's ``ref`` reaches behind
+    the W-entry ring (concurrent trunk commits were already evicted, so the
+    rebase chain would be incomplete) — callers must fall back to the host
+    path for that stream rather than trust the result."""
     Lc = doc_ids.shape[-1]
     Pc = commits.ins_ids.shape[-1]
     ring_del = jnp.zeros((W, Lc), jnp.int32)
@@ -65,9 +69,15 @@ def trunk_scan(doc_ids, L, commits: CommitBatch, W: int):
     ring_seq = jnp.zeros(W, jnp.int32)  # 0 = empty slot
 
     def step(carry, inp):
-        doc_ids, L, ring_del, ring_ins, ring_ids, ring_L, ring_seq, k = carry
+        (doc_ids, L, ring_del, ring_ins, ring_ids, ring_L, ring_seq, k,
+         err) = carry
         c = DenseChange(inp["del"], inp["ins"], inp["ids"])
         ref = inp["ref"]
+        # Ring-window guard: commit k rebases over trunk seqs [ref+1, k).
+        # The ring retains seqs [max(1, k-W), k); a needed seq was evicted
+        # iff ref+1 < k-W (vacuously false while k <= W+1), and the fold
+        # below would silently skip it.
+        err = err | (ref + 1 < k - W).astype(jnp.int32)
 
         # Fold over the ring oldest -> newest: rebase over every trunk
         # commit concurrent with this one (seq > ref). Inactive entries
@@ -89,12 +99,12 @@ def trunk_scan(doc_ids, L, commits: CommitBatch, W: int):
         ring_seq = jnp.roll(ring_seq, -1).at[W - 1].set(k)
         return (
             new_doc, new_L, ring_del, ring_ins, ring_ids, ring_L,
-            ring_seq, k + 1,
+            ring_seq, k + 1, err,
         ), None
 
     init = (
         doc_ids, L, ring_del, ring_ins, ring_ids, ring_L, ring_seq,
-        jnp.int32(1),
+        jnp.int32(1), jnp.int32(0),
     )
     xs = {
         "del": commits.del_mask,
@@ -102,13 +112,15 @@ def trunk_scan(doc_ids, L, commits: CommitBatch, W: int):
         "ids": commits.ins_ids,
         "ref": commits.ref,
     }
-    (doc_ids, L, *_), _ = jax.lax.scan(step, init, xs)
-    return doc_ids, L
+    carry, _ = jax.lax.scan(step, init, xs)
+    doc_ids, L, err = carry[0], carry[1], carry[-1]
+    return doc_ids, L, err
 
 
 @partial(jax.jit, static_argnums=(3,))
 def batched_trunk_scan(doc_ids, L, commits: CommitBatch, W: int):
-    """[N, ...] documents, each with its own C-commit stream."""
+    """[N, ...] documents, each with its own C-commit stream. Returns
+    ``(doc_ids, L, err)`` with a per-document sticky window-overflow lane."""
     return jax.vmap(lambda d, l, cb: trunk_scan(d, l, cb, W))(
         doc_ids, L, commits
     )

@@ -28,7 +28,18 @@ def _load_lib(so_name: str) -> Optional[ctypes.CDLL]:
         return _libs[so_name]
     _libs[so_name] = None
     so = os.path.join(_NATIVE_DIR, so_name)
-    if not os.path.exists(so):
+    # Rebuild when missing OR stale vs any source/Makefile — binaries are
+    # not checked in, and a stale .so must never shadow source changes.
+    stale = not os.path.exists(so)
+    if not stale:
+        so_mtime = os.path.getmtime(so)
+        for f in os.listdir(_NATIVE_DIR):
+            if (f.endswith((".cpp", ".h", ".hpp")) or f == "Makefile") and (
+                os.path.getmtime(os.path.join(_NATIVE_DIR, f)) > so_mtime
+            ):
+                stale = True
+                break
+    if stale:
         try:
             subprocess.run(
                 ["make", "-C", _NATIVE_DIR],
@@ -37,6 +48,8 @@ def _load_lib(so_name: str) -> Optional[ctypes.CDLL]:
                 timeout=120,
             )
         except (subprocess.SubprocessError, OSError):
+            # A failed rebuild of a stale binary falls back to pure Python
+            # rather than silently running outdated native code.
             return None
     try:
         _libs[so_name] = ctypes.CDLL(so)

@@ -23,7 +23,8 @@ def test_device_trunk_matches_host(seed):
     batch = to_device_batch(streams, Lc, Pc)
     doc_ids = np.zeros((n_docs, Lc), np.int32)
     L0 = np.zeros(n_docs, np.int32)
-    out_ids, out_L = batched_trunk_scan(doc_ids, L0, batch, W)
+    out_ids, out_L, err = batched_trunk_scan(doc_ids, L0, batch, W)
+    assert not np.asarray(err).any()
     for d in range(n_docs):
         want = host_trunk(streams[d])
         got = TK.dense_to_doc(out_ids[d], out_L[d])
@@ -39,7 +40,43 @@ def test_device_trunk_single_session_is_sequential_apply():
         (2, [M.skip(2), M.insert([4])]),
     ]
     batch = to_device_batch([commits], Lc, Pc)
-    out_ids, out_L = batched_trunk_scan(
+    out_ids, out_L, err = batched_trunk_scan(
         np.zeros((1, Lc), np.int32), np.zeros(1, np.int32), batch, W
     )
+    assert not np.asarray(err).any()
     assert TK.dense_to_doc(out_ids[0], out_L[0]) == [1, 3, 4]
+
+
+def test_ring_window_overflow_flagged():
+    """A commit whose ref reaches behind the W-entry ring must raise the
+    sticky err lane — the evicted concurrent commits can't be rebased over
+    (ADVICE r2). W=2, 4 commits, last one refs seq 0 (concurrent with all)."""
+    Lc, Pc, W = 32, 16, 2
+    commits = [
+        (0, [M.insert([1])]),
+        (1, [M.skip(1), M.insert([2])]),
+        (2, [M.skip(2), M.insert([3])]),
+        (0, [M.insert([9])]),  # ref=0: seqs 1..3 concurrent, ring holds 2
+    ]
+    batch = to_device_batch([commits], Lc, Pc)
+    _, _, err = batched_trunk_scan(
+        np.zeros((1, Lc), np.int32), np.zeros(1, np.int32), batch, W
+    )
+    assert int(np.asarray(err)[0]) == 1
+
+
+def test_ring_window_boundary_not_flagged():
+    """ref exactly k-W-1 needs seqs k-W..k-1 — precisely what the ring
+    retains — so it must NOT flag (and must still merge correctly)."""
+    Lc, Pc, W = 32, 16, 2
+    commits = [
+        (0, [M.insert([1])]),
+        (1, [M.skip(1), M.insert([2])]),
+        (0, [M.insert([9])]),  # k=3, ref=0=k-W-1: ring holds seqs {1,2}
+    ]
+    batch = to_device_batch([commits], Lc, Pc)
+    out_ids, out_L, err = batched_trunk_scan(
+        np.zeros((1, Lc), np.int32), np.zeros(1, np.int32), batch, W
+    )
+    assert int(np.asarray(err)[0]) == 0
+    assert TK.dense_to_doc(out_ids[0], out_L[0]) == host_trunk(commits)

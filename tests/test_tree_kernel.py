@@ -91,7 +91,8 @@ def test_compose_parity(seed):
     Lb = np.asarray([L], np.int32)
     da = tree_map_batch(dense(a)[0])
     db = tree_map_batch(dense(b)[0])
-    ab = TK.batched_compose(da, db, Lb)
+    ab, ovf = TK.batched_compose(da, db, Lb)
+    assert int(ovf[0]) == 0
     out, out_L = TK.batched_apply(ids[None], Lb, ab)
     assert TK.dense_to_doc(out[0], out_L[0]) == M.apply(s, M.compose(a, b))
 
@@ -108,11 +109,11 @@ def test_compose_associative_on_device(seed):
     ids, L = TK.doc_to_dense(s, LC)
     Lb = np.asarray([L], np.int32)
     da, db, dc = (tree_map_batch(dense(x)[0]) for x in (a, b, c))
-    ab = TK.batched_compose(da, db, Lb)
-    left = TK.batched_compose(ab, dc, Lb)
+    ab, _ = TK.batched_compose(da, db, Lb)
+    left, _ = TK.batched_compose(ab, dc, Lb)
     La1 = TK.out_len(TK.DenseChange(*[x[0] for x in da]), np.int32(L))
-    bc = TK.batched_compose(db, dc, np.asarray([La1], np.int32))
-    right = TK.batched_compose(da, bc, Lb)
+    bc, _ = TK.batched_compose(db, dc, np.asarray([La1], np.int32))
+    right, _ = TK.batched_compose(da, bc, Lb)
     o1, l1 = TK.batched_apply(ids[None], Lb, left)
     o2, l2 = TK.batched_apply(ids[None], Lb, right)
     assert TK.dense_to_doc(o1[0], l1[0]) == TK.dense_to_doc(o2[0], l2[0])
@@ -143,6 +144,32 @@ def test_rebase_insert_inside_deleted_range_slides_on_device():
     so, Lo = TK.batched_apply(ids[None], Lb, do)
     out, oL = TK.batched_apply(so, Lo, TK.batched_rebase(dc, do, Lb, False))
     assert TK.dense_to_doc(out[0], oL[0]) == [1, 9, 4]
+
+
+def test_compose_pool_overflow_flagged():
+    """Composing changes whose merged live inserts exceed Pc must raise the
+    overflow lane instead of silently truncating (ADVICE r2)."""
+    small_pc = 4
+    a = [M.insert([21, 22, 23])]
+    b = [M.insert([11, 12, 13])]
+    da, _ = TK.from_marks(a, LC, small_pc)
+    db, _ = TK.from_marks(b, LC, small_pc)
+    L = np.asarray([0], np.int32)
+    comp, ovf = TK.batched_compose(
+        TK.DenseChange(*[np.asarray(x)[None] for x in da]),
+        TK.DenseChange(*[np.asarray(x)[None] for x in db]),
+        L,
+    )
+    assert int(ovf[0]) == 1
+    # A fitting compose of the same shape stays clean.
+    da2, _ = TK.from_marks([M.insert([21, 22])], LC, small_pc)
+    db2, _ = TK.from_marks([M.insert([11])], LC, small_pc)
+    _, ovf2 = TK.batched_compose(
+        TK.DenseChange(*[np.asarray(x)[None] for x in da2]),
+        TK.DenseChange(*[np.asarray(x)[None] for x in db2]),
+        L,
+    )
+    assert int(ovf2[0]) == 0
 
 
 def test_batched_independence():
