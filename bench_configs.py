@@ -324,178 +324,170 @@ def config4_matrix_axis_merge(n_docs: int, k: int, on_tpu: bool) -> None:
 
 
 def config5_deli_scribe_e2e(n_docs: int, ops_per_doc: int, on_tpu: bool) -> None:
-    """Honest end-to-end service shape (TpuDeliLambda + scribe):
+    """End-to-end service shape THROUGH the product path (VERDICT r2 #1):
+    this config drives :class:`~fluidframework_tpu.service.fleet_service.
+    TpuFleetService` — native deli ticketing, fused Pallas apply, and the
+    device scribe — via its public API only. Nothing here touches kernels
+    or ticket loops directly; the numbers are the serving path.
 
-    - EVERY document runs its own real deli ticket loop — no script tiling
-      (the host sequencing cost of the whole fleet is the number being
-      measured; reference deli/lambda.ts:742);
-    - a scribe stage writes logTail service summaries for a rotating slice
-      of the fleet into the summary store INSIDE the timed loop (reference
-      scribe/lambda.ts:106,304);
-    - double-buffered boxcars: round r+1's host sequencing and the scribe
-      writes overlap the device's round r (async dispatch; the err-lane
-      readback is the barrier — SURVEY §7 hard part f);
-    - device-only step time is measured separately on a pre-staged chain,
-      so the dev tunnel's dispatch round-trip is amortized out.
+    - EVERY document runs the real ticket loop per round (no tiling);
+    - the scribe stage runs INSIDE the timed loop: logTail blobs for a
+      rotating fleet slice plus device-state summaries (dirty-doc
+      readback), with the readback cost measured and reported;
+    - double-buffered boxcars: round r+1's host generation overlaps the
+      device's round r (async dispatch; the err-lane readback barriers);
+    - device-only step time measured separately on a pre-staged chain.
     """
     import jax
 
     from fluidframework_tpu.ops.pallas_compact import compact_packed
-    from fluidframework_tpu.ops.pallas_kernel import (
-        SC_ERR,
-        apply_ops_packed,
-        pack_state,
-    )
-    from fluidframework_tpu.ops import encode as E
-    from fluidframework_tpu.ops.segment_state import make_batched_state
-    from fluidframework_tpu.protocol.constants import NO_CLIENT, OP_WIDTH
-    from fluidframework_tpu.service.summary_store import SummaryStore
-
+    from fluidframework_tpu.ops.pallas_kernel import apply_ops_packed
     from fluidframework_tpu.protocol.constants import (
         F_ARG,
-        F_CLIENT,
         F_LEN,
-        F_MSN,
         F_POS1,
         F_POS2,
-        F_REF,
-        F_SEQ,
         F_TYPE,
         OP_INSERT,
         OP_REMOVE,
+        OP_WIDTH,
     )
-    from fluidframework_tpu.service.fleet_sequencer import FleetSequencer
+    from fluidframework_tpu.service.fleet_service import TpuFleetService
 
     rng = np.random.default_rng(0)
     rounds = 3
-    fseq = FleetSequencer(n_docs)
-    joins = fseq.join_all(slot=0)
-    host_backend = "native-c++" if fseq.native_available else "python"
+    blk = 32 if on_tpu else 8
+    svc = TpuFleetService(
+        n_docs, capacity=128, block_docs=blk, interpret=not on_tpu,
+        compact_every=1,
+    )
+    svc.join_writer(0)
+    host_backend = (
+        "native-c++" if svc.fseq.native_available else "python"
+    )
     lengths = np.zeros(n_docs, np.int64)
     cseqs = np.zeros(n_docs, np.int64)
-    store = SummaryStore()
-    summary_writes = 0
 
-    def sequence_round() -> np.ndarray:
-        """Host stage: real deli ticketing for EVERY document through the
-        native batch ticket loop (ticket_loop.cpp; Python fallback keeps
-        identical semantics), content generation vectorized across the
-        fleet. Each round closes with a whole-doc remove + window advance
-        so the device tables stay bounded (steady state)."""
+    def generate_round():
+        """Host content generation only — ticketing/stamping is the
+        service's job (submit_round). Each round closes with a whole-doc
+        remove + window advance so device tables stay bounded."""
         k = ops_per_doc
-        batches = np.zeros((n_docs, k, OP_WIDTH), np.int32)
+        rows = np.zeros((n_docs, k, OP_WIDTH), np.int32)
         intents = np.zeros((n_docs, k, 3), np.int32)
-        start_seq = fseq.doc_state[:, 0].astype(np.int64)
+        start_seq = svc.fseq.doc_state[:, 0].astype(np.int64)
         for i in range(k):
             cseqs[:] += 1
             intents[:, i, 0] = 0  # writer slot
             intents[:, i, 1] = cseqs
             intents[:, i, 2] = start_seq + i  # caught-up perspective
             if i == k - 1:
-                batches[:, i, F_TYPE] = OP_REMOVE
-                batches[:, i, F_POS1] = 0
-                batches[:, i, F_POS2] = lengths
+                rows[:, i, F_TYPE] = OP_REMOVE
+                rows[:, i, F_POS1] = 0
+                rows[:, i, F_POS2] = lengths
                 lengths[:] = 0
             else:
                 roll = rng.random(n_docs)
                 pos = rng.random(n_docs)
                 rem = (lengths >= 6) & (roll < 0.4)
                 a = (pos * np.maximum(lengths - 2, 1)).astype(np.int64)
-                batches[:, i, F_TYPE] = np.where(rem, OP_REMOVE, OP_INSERT)
-                batches[:, i, F_POS1] = np.where(
+                rows[:, i, F_TYPE] = np.where(rem, OP_REMOVE, OP_INSERT)
+                rows[:, i, F_POS1] = np.where(
                     rem, a, (pos * (lengths + 1)).astype(np.int64)
                 )
-                batches[:, i, F_POS2] = np.where(rem, a + 2, 0)
-                batches[:, i, F_ARG] = np.where(rem, 0, 10 + i)
-                batches[:, i, F_LEN] = np.where(rem, 0, 3)
+                rows[:, i, F_POS2] = np.where(rem, a + 2, 0)
+                rows[:, i, F_ARG] = np.where(rem, 0, 10 + i)
+                rows[:, i, F_LEN] = np.where(rem, 0, 3)
                 lengths[:] += np.where(rem, -2, 3)
-        out, err = fseq.ticket_batch(intents)
-        assert not err.any(), "steady-state stream must stay on the fast path"
-        batches[:, :, F_SEQ] = out[:, :, 0]
-        batches[:, :, F_REF] = out[:, :, 0] - 1
-        batches[:, :, F_MSN] = out[:, :, 1]
-        batches[:, :, F_CLIENT] = 0
-        # Close the collab window on the round's last op so compaction
-        # reclaims the emptied tables (zamboni steady state).
-        batches[:, k - 1, F_MSN] = batches[:, k - 1, F_SEQ]
-        return batches
+        return intents, rows
 
-    def scribe_round(r: int, batches: np.ndarray) -> int:
-        """Service-summary stage: persist the logTail (this round's
-        sequenced rows) for the 1/rounds slice of docs due this round."""
+    def scribe_logtail(r: int, rows: np.ndarray) -> int:
+        """LogTail persistence for the 1/rounds slice due this round
+        (reference scribe/lambda.ts:304) into the service's store."""
         n = 0
         for d in range(r, n_docs, rounds):
-            store.put_blob(
+            svc.store.put_blob(
                 json.dumps(
-                    {"doc": f"doc{d}", "head": int(fseq.doc_state[d, 0])}
+                    {"doc": f"doc{d}", "head": int(svc.fseq.doc_state[d, 0])}
                 ).encode()
-                + batches[d].tobytes()
+                + rows[d].tobytes()
             )
             n += 1
         return n
 
-    tables, scalars = pack_state(make_batched_state(n_docs, 128, NO_CLIENT))
-    blk = 32 if on_tpu else 8
-    # Warmup compiles both kernels at the fleet shape.
-    jops = jax.device_put(sequence_round())
-    tables, scalars = apply_ops_packed(
-        tables, scalars, jops, block_docs=blk, interpret=not on_tpu
-    )
-    tables, scalars = compact_packed(tables, scalars, interpret=not on_tpu)
-    assert int(np.asarray(scalars[:, SC_ERR]).sum()) == 0, (
+    # Warmup compiles both kernels at the fleet shape via the service API.
+    intents, rows = generate_round()
+    err, stamped = svc.submit_round(intents, rows)
+    assert not err.any(), "warmup tickets must stay on the fast path"
+    assert int(svc.device_errors().sum()) == 0, (
         "warmup round must be clean — errs below count timed rounds only"
     )
 
     t0 = time.perf_counter()
-    t_seq = 0.0  # deli ticket loops only
-    t_scribe = 0.0  # summary writes only
+    t_gen = 0.0  # host content generation
+    t_ticket = 0.0  # native deli ticket loops (inside submit_round)
+    t_scribe = 0.0  # logTail writes
+    t_summary = 0.0  # device-scribe readback + serialization
+    logtail_writes = 0
+    summary_docs = 0
+    summary_bytes = 0
     th = time.perf_counter()
-    batch = sequence_round()  # round 0's boxcar
-    t_seq += time.perf_counter() - th
+    batch = generate_round()  # round 0's boxcar
+    t_gen += time.perf_counter() - th
     for r in range(rounds):
-        jops = jax.device_put(batch)
-        tables, scalars = apply_ops_packed(
-            tables, scalars, jops, block_docs=blk, interpret=not on_tpu
-        )
-        tables, scalars = compact_packed(
-            tables, scalars, interpret=not on_tpu
-        )
-        # Overlap window: while the device chews round r, the host runs the
-        # scribe stage and stages round r+1 (double-buffered boxcar).
+        err, stamped = svc.submit_round(*batch)
+        assert not err.any(), "steady-state stream must stay on fast path"
+        t_ticket += svc.last_ticket_s
+        # Overlap window: while the device chews round r, the host runs
+        # the scribe stage and stages round r+1 (double-buffered boxcar).
         th = time.perf_counter()
-        summary_writes += scribe_round(r, batch)
+        logtail_writes += scribe_logtail(r, stamped)
         t_scribe += time.perf_counter() - th
+        th = time.perf_counter()
+        nd, nb = svc.summarize_dirty(
+            threshold=1, max_docs=max(1, n_docs // rounds)
+        )
+        t_summary += time.perf_counter() - th
+        summary_docs += nd
+        summary_bytes += nb
         if r + 1 < rounds:
             th = time.perf_counter()
-            batch = sequence_round()
-            t_seq += time.perf_counter() - th
-        errs = int(np.asarray(scalars[:, SC_ERR]).sum())  # barrier
+            batch = generate_round()
+            t_gen += time.perf_counter() - th
+        errs = int(svc.device_errors().sum())  # barrier
     dt = time.perf_counter() - t0
 
-    # Device-only step time: a pre-staged chain of steps with ONE readback
-    # at the end — dispatch/tunnel overhead amortizes out. Seq stamps in the
-    # replayed batch repeat, which is harmless for the apply cost.
+    # Device-only step time: a pre-staged chain with ONE readback at the
+    # end — dispatch/tunnel overhead amortizes out. Repeated seq stamps in
+    # the replayed batch are harmless for the apply cost.
     chain = 10
+    jops = jax.device_put(stamped)
     td = time.perf_counter()
     for _ in range(chain):
-        tables, scalars = apply_ops_packed(
-            tables, scalars, jops, block_docs=blk, interpret=not on_tpu
+        svc.tables, svc.scalars = apply_ops_packed(
+            svc.tables, svc.scalars, jops,
+            block_docs=blk, interpret=not on_tpu,
         )
-        tables, scalars = compact_packed(
-            tables, scalars, interpret=not on_tpu
+        svc.tables, svc.scalars = compact_packed(
+            svc.tables, svc.scalars, interpret=not on_tpu
         )
-    np.asarray(scalars[:, SC_ERR])
+    svc.device_errors()  # the barrier readback
     device_step_ms = (time.perf_counter() - td) / chain * 1e3
 
     total = n_docs * ops_per_doc * rounds
     _emit(
         metric="deli_scribe_e2e_ops_per_sec", value=round(total / dt),
         unit="ops/s", config=5, n_docs=n_docs, host_docs=n_docs,
-        host_stage_s=round(t_seq + t_scribe, 3),
-        host_seq_s=round(t_seq, 3), scribe_s=round(t_scribe, 3),
-        host_tickets_per_sec=round(total / t_seq),
+        service_path="TpuFleetService",
+        host_stage_s=round(t_gen + t_ticket + t_scribe + t_summary, 3),
+        host_seq_s=round(t_gen + t_ticket, 3),
+        host_tickets_per_sec=round(total / max(t_ticket, 1e-9)),
         host_backend=host_backend,
-        summary_writes=summary_writes,
+        scribe_s=round(t_scribe, 3),
+        logtail_writes=logtail_writes,
+        summary_writes=summary_docs,
+        summary_readback_ms=round(t_summary * 1e3, 1),
+        summary_bytes_per_doc=round(summary_bytes / max(summary_docs, 1)),
         device_step_ms=round(device_step_ms, 3), errs=errs,
     )
 

@@ -12,6 +12,14 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+# Honor JAX_PLATFORMS=cpu even where a sitecustomize pre-registers an
+# accelerator backend (env alone is not enough there; tests set this so the
+# demo never depends on accelerator availability).
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 from fluidframework_tpu.drivers.network_driver import NetworkFluidService
 from fluidframework_tpu.models.shared_map import SharedMap
 from fluidframework_tpu.models.shared_string import SharedString

@@ -11,6 +11,13 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+# Honor JAX_PLATFORMS=cpu even where a sitecustomize pre-registers an
+# accelerator backend (see collab_editor.py).
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 from fluidframework_tpu.models.shared_map import SharedMap
 from fluidframework_tpu.runtime.container import ContainerRuntime
 from fluidframework_tpu.service.local_server import LocalFluidService

@@ -273,6 +273,30 @@ class NetworkFluidService:
             self.host, self.port, doc_id, self.tenant, token, mode, from_seq
         )
 
+    def get_channel_text(self, doc_id: str, channel_id: str) -> str:
+        """Read a string channel straight from the service's device-resident
+        replica (GET /documents/:id/channels/:cid) — no container needed."""
+        q = self._auth(doc_id)
+        url = (
+            f"http://{self.host}:{self.port}/documents/{doc_id}"
+            f"/channels/{channel_id}" + (f"?{q}" if q else "")
+        )
+        with urlopen(url, timeout=10) as r:
+            return json.loads(r.read())["text"]
+
+    def get_channel_summary(self, doc_id: str, channel_id: str) -> dict:
+        """Device-produced channel summary over REST (view=summary)."""
+        q = "view=summary"
+        auth = self._auth(doc_id)
+        if auth:
+            q += "&" + auth
+        url = (
+            f"http://{self.host}:{self.port}/documents/{doc_id}"
+            f"/channels/{channel_id}?{q}"
+        )
+        with urlopen(url, timeout=10) as r:
+            return json.loads(r.read())
+
     def get_deltas(self, doc_id: str, from_seq: int = 0,
                    to_seq: Optional[int] = None):
         q = f"from={from_seq}" + (f"&to={to_seq}" if to_seq is not None else "")

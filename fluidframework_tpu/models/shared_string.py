@@ -59,6 +59,32 @@ def _delta_from_contents(c: dict) -> dict:
     raise ValueError(f"unknown SharedString op {c!r}")
 
 
+def row_from_wire(
+    contents: dict, *, seq: int, ref: int, client: int, msn: int,
+    payloads: dict,
+) -> Optional[np.ndarray]:
+    """Lower sequenced SharedString wire contents to one kernel op row —
+    the shared decode used by client replicas (``process_core``) and the
+    service-side device stage (``service/device_backend.py``), so both
+    apply byte-identical rows. Inserts record their payload text; returns
+    None for non-kernel ops (interval-collection bodies)."""
+    k = contents.get("k")
+    common = dict(seq=seq, ref=ref, client=client, msn=msn)
+    if k == "ins":
+        payloads[contents["orig"]] = contents["text"]
+        return E.insert(
+            contents["pos"], contents["orig"], len(contents["text"]),
+            **common,
+        )
+    if k == "rem":
+        return E.remove(contents["start"], contents["end"], **common)
+    if k == "ann":
+        return E.annotate(
+            contents["start"], contents["end"], contents["val"], **common
+        )
+    return None
+
+
 class SharedString(SharedObject):
     """Collaborative sequence of text with LWW annotations (single lane)."""
 
@@ -305,19 +331,17 @@ class SharedString(SharedObject):
             self._normalize_refs()
 
     def _row_from_contents(self, msg: SequencedDocumentMessage) -> np.ndarray:
-        d = _delta_from_contents(msg.contents)
-        common = dict(
+        row = row_from_wire(
+            msg.contents,
             seq=msg.sequence_number,
             ref=msg.reference_sequence_number,
             client=msg.client_id,
             msn=msg.minimum_sequence_number,
+            payloads=self._payloads,
         )
-        if d["kind"] == "insert":
-            self._payloads[d["orig"]] = d["text"]
-            return E.insert(d["pos"], d["orig"], len(d["text"]), **common)
-        if d["kind"] == "remove":
-            return E.remove(d["start"], d["end"], **common)
-        return E.annotate(d["start"], d["end"], d["val"], **common)
+        if row is None:
+            raise ValueError(f"unknown SharedString op {msg.contents!r}")
+        return row
 
     def _apply(self, row: np.ndarray) -> None:
         self._state = jit_apply_ops(self._state, row[None, :].astype(np.int32))

@@ -246,6 +246,29 @@ class FluidNetworkServer:
             doc_id = req.get("id") or f"doc-{secrets.token_hex(6)}"
             self.service._doc(doc_id)
             reply(201, json.dumps({"id": doc_id}).encode())
+        elif (
+            method == "GET"
+            and len(parts) == 4
+            and parts[0] == "documents"
+            and parts[2] == "channels"
+        ):
+            # Device-served read (GET /documents/:id/channels/:cid?view=…):
+            # the string channel's state straight from the service's
+            # device-resident replica — no client replica involved.
+            if getattr(self.service, "device", None) is None:
+                reply(501, b'{"error": "device backend unsupported"}')
+                await writer.drain()
+                return
+            doc_id, channel_id = parts[1], parts[3]
+            self.service.pump()  # settle so fresh channels are visible
+            if not self.service.device.has_channel(doc_id, channel_id):
+                reply(404, b'{"error": "unknown channel"}')
+            elif query.get("view") == "summary":
+                summary = self.service.device_summary(doc_id, channel_id)
+                reply(200, json.dumps(summary).encode())
+            else:
+                text = self.service.device_text(doc_id, channel_id)
+                reply(200, json.dumps({"text": text}).encode())
         elif method == "GET" and len(parts) == 2 and parts[0] == "documents":
             # Metadata (alfred GET /documents/:id): existence, head seq,
             # latest acked summary pointer, connected clients.

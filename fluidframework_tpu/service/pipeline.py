@@ -19,10 +19,12 @@ from typing import Any, Callable, Dict, List, Optional
 from fluidframework_tpu.protocol.types import (
     DocumentMessage,
     MessageType,
+    NackErrorType,
     NackMessage,
     SequencedDocumentMessage,
     SignalMessage,
 )
+from fluidframework_tpu.telemetry import LumberEventName, Lumberjack
 from fluidframework_tpu.service.lambdas import (
     DELTAS_TOPIC,
     RAW_TOPIC,
@@ -84,6 +86,9 @@ class PipelineFluidService:
         n_partitions: int = 4,
         checkpoint_every: int = 10,
         messages_per_trace: int = 0,
+        device_backend: bool = True,
+        device_capacity: int = 128,
+        device_max_capacity: int = 1 << 16,
     ):
         self.log = PartitionedLog(n_partitions)
         self.store = SummaryStore()
@@ -112,6 +117,37 @@ class PipelineFluidService:
             self.log, SIGNALS_TOPIC, "signal-broadcaster",
             lambda p, s: SignalBroadcasterLambda(self.rooms),
             self.checkpoints, checkpoint_every,
+        )
+        # The device-apply stage (TpuDeliLambda): the service's replica of
+        # every string channel lives in a DocFleet on the accelerator.
+        # Deliberately NOT in self.checkpoints — its durable form is the
+        # deltas log itself; crash recovery replays from offset 0 (see
+        # service/device_lambda.py).
+        self.device: Optional[Any] = None
+        self._device_runner: Optional[PartitionRunner] = None
+        if device_backend:
+            self._make_device(device_capacity, device_max_capacity)
+
+    def _make_device(self, capacity: int, max_capacity: int) -> None:
+        from fluidframework_tpu.service.device_backend import (
+            DeviceFleetBackend,
+        )
+        from fluidframework_tpu.service.device_lambda import TpuDeliLambda
+
+        self.device = DeviceFleetBackend(
+            capacity=capacity, max_capacity=max_capacity
+        )
+        self._device_capacity = (capacity, max_capacity)
+
+        def factory(p: int, state):
+            return DocumentLambda(
+                lambda doc_id, s: TpuDeliLambda(doc_id, self.device)
+            )
+
+        self._device_runner = PartitionRunner(
+            self.log, DELTAS_TOPIC, "tpu-deli", factory,
+            CheckpointStore(),  # throwaway: never restored across crashes
+            checkpoint_every=1 << 30,
         )
 
     # -- lambda (re)construction: also the crash-recovery entry points --------
@@ -167,9 +203,68 @@ class PipelineFluidService:
                 + self._broadcaster.pump()
                 + self._signals.pump()
             )
+            if self._device_runner is not None:
+                n += self._device_runner.pump()
             total += n
             if n == 0:
+                # Quiescent: boxcar any freshly buffered device rows and
+                # surface err-lane feedback now — nacks must reach clients
+                # on the ingestion path, not only when someone reads.
+                if self.device is not None and (
+                    self.device._buffered_rows or self.device._unreported
+                ):
+                    self.flush_device()
                 return total
+
+    # -- the device serving surface -------------------------------------------
+
+    def flush_device(self) -> None:
+        """Boxcar every buffered device row into batched kernel dispatches
+        and turn any newly tripped err lanes into nacks + telemetry (the
+        deli control-plane feedback: reference deli/lambda.ts nack
+        branches)."""
+        if self.device is None:
+            return
+        self.device.flush()
+        for doc_id, address in self.device.take_errors():
+            Lumberjack.new_metric(
+                LumberEventName.DeviceCapacity,
+                {"tenantId": "local", "documentId": doc_id,
+                 "address": address},
+            ).error("device channel capacity exceeded")
+            nack = NackMessage(
+                sequence_number=0,
+                content_code=429,
+                error_type=NackErrorType.LIMIT_EXCEEDED,
+                message=f"channel {address} exceeded device capacity",
+            )
+            for conn in self.rooms.get(doc_id, []):
+                conn.nacks.append(nack)
+                if conn.on_nack:
+                    conn.on_nack(nack)
+
+    def device_text(self, doc_id: str, channel_id: str) -> str:
+        """Read a string channel's current text straight from the device
+        replica — the serving path that never touches a client."""
+        assert self.device is not None, "device backend disabled"
+        self.pump()
+        self.flush_device()
+        return self.device.text(doc_id, channel_id)
+
+    def device_summary(self, doc_id: str, channel_id: str):
+        """Channel summary produced from device state (the device-scribe
+        producer; see service/device_scribe.py for the service stage)."""
+        assert self.device is not None, "device backend disabled"
+        self.pump()
+        self.flush_device()
+        return self.device.channel_summary(doc_id, channel_id)
+
+    def crash_device(self) -> None:
+        """Kill the device stage (fleet state and consumer offsets gone)
+        and restart it cold: the new consumer replays the deltas log from
+        offset zero and deterministically rebuilds every channel replica."""
+        assert self.device is not None, "device backend disabled"
+        self._make_device(*self._device_capacity)
 
     # -- the LocalFluidService-compatible surface ------------------------------
 

@@ -29,6 +29,8 @@ class LumberEventName:
     CheckpointWrite = "CheckpointWrite"
     SessionResult = "SessionResult"
     TotalConnectionCount = "TotalConnectionCount"
+    DeviceCapacity = "DeviceCapacity"
+    DeviceApply = "DeviceApply"
 
 
 class LumberType:

