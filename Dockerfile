@@ -36,7 +36,8 @@ COPY --from=build /app/native ./native
 COPY config ./config
 
 ENV FLUID_HOST=0.0.0.0 \
-    FLUID_PORT=7070
+    FLUID_PORT=7070 \
+    FLUID_NATIVE_DIR=/app/native
 
 EXPOSE 7070
 

@@ -79,11 +79,7 @@ def _np_batched_state(n_docs: int, capacity: int) -> SegmentState:
     )
 
 
-def _pow2_at_least(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
+from fluidframework_tpu.utils import pow2_at_least as _pow2_at_least
 
 
 class _Pool:

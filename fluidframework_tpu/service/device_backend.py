@@ -36,6 +36,7 @@ from fluidframework_tpu.ops.segment_state import (
 )
 from fluidframework_tpu.parallel.fleet import DocFleet
 from fluidframework_tpu.protocol.constants import F_SEQ, OP_WIDTH
+from fluidframework_tpu.utils import pow2_at_least as _pow2_at_least
 
 ChannelKey = Tuple[str, str]  # (doc_id, channel address)
 
@@ -214,10 +215,3 @@ class DeviceFleetBackend:
             flushes=self._flushes,
         )
         return s
-
-
-def _pow2_at_least(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p

@@ -26,7 +26,8 @@ the service path, not a harness.
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Optional, Tuple
+import time
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,8 +39,10 @@ _gather_docs = jax.jit(lambda tables, idx: jnp.take(tables, idx, axis=1))
 
 from fluidframework_tpu.ops.pallas_compact import compact_packed
 from fluidframework_tpu.ops.pallas_kernel import (
+    SC_COUNT,
     SC_CUR_SEQ,
     SC_ERR,
+    SC_MIN_SEQ,
     apply_ops_packed,
     pack_state,
     unpack_state,
@@ -75,8 +78,6 @@ class TpuFleetService:
         store: Optional[SummaryStore] = None,
         compact_every: int = 1,
     ):
-        import jax
-
         self.n_docs = n_docs
         self.capacity = capacity
         self.block_docs = block_docs
@@ -93,7 +94,6 @@ class TpuFleetService:
         # Device-scribe watermark: last summarized seq per doc (host [D]).
         self._summarized_seq = np.zeros(n_docs, np.int64)
         self._summary_handles: Dict[int, str] = {}
-        self._jax = jax
 
     # -- front door ------------------------------------------------------------
 
@@ -114,8 +114,6 @@ class TpuFleetService:
         the slow path; its rows are NOT applied) and the sequenced rows as
         applied (refused docs zeroed to NOOPs) — what scriptorium/logTail
         persistence must record."""
-        import time
-
         t0 = time.perf_counter()
         out, err = self.fseq.ticket_batch(intents)
         self.last_ticket_s = time.perf_counter() - t0
@@ -126,7 +124,7 @@ class TpuFleetService:
         rows[:, :, F_CLIENT] = intents[:, :, 0]
         if err.any():
             rows[err != 0] = 0  # refused documents apply nothing (NOOPs)
-        jops = self._jax.device_put(rows)
+        jops = jax.device_put(rows)
         self.tables, self.scalars = apply_ops_packed(
             self.tables, self.scalars, jops,
             block_docs=self.block_docs, interpret=self.interpret,
@@ -182,7 +180,7 @@ class TpuFleetService:
         idx = np.full(padded, dirty[0], np.int32)
         idx[: dirty.size] = dirty
         slices = np.asarray(
-            _gather_docs(self.tables, self._jax.device_put(idx))
+            _gather_docs(self.tables, jax.device_put(idx))
         )[:, : dirty.size]
         scal = scal_all[dirty]
         total = 0
@@ -206,12 +204,12 @@ class TpuFleetService:
     def _serialize_doc(doc: int, lanes: np.ndarray, scalars: np.ndarray):
         """Compact binary: header JSON line + raw int32 lane block (only
         rows below the doc's count high-water mark)."""
-        n = int(scalars[0])
+        n = int(scalars[SC_COUNT])
         head = json.dumps(
             {
                 "doc": doc,
                 "count": n,
-                "min_seq": int(scalars[1]),
+                "min_seq": int(scalars[SC_MIN_SEQ]),
                 "cur_seq": int(scalars[SC_CUR_SEQ]),
                 "lanes": list(SEGMENT_LANES),
             },

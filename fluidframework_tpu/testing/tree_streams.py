@@ -98,6 +98,9 @@ def to_device_batch(all_commits, Lc, Pc):
     ic = np.zeros((n_docs, C, Lc + 1), np.int32)
     ii = np.zeros((n_docs, C, Pc), np.int32)
     refs = np.zeros((n_docs, C), np.int32)
+    seqs = np.broadcast_to(
+        np.arange(1, C + 1, dtype=np.int32), (n_docs, C)
+    ).copy()
     for d, commits in enumerate(all_commits):
         for k, (ref, c) in enumerate(commits):
             dc, _ = TK.from_marks(c, Lc, Pc)
@@ -105,6 +108,6 @@ def to_device_batch(all_commits, Lc, Pc):
             ic[d, k] = np.asarray(dc.ins_cnt)
             ii[d, k] = np.asarray(dc.ins_ids)
             refs[d, k] = ref
-    return CommitBatch(dm, ic, ii, refs)
+    return CommitBatch(dm, ic, ii, refs, seqs)
 
 

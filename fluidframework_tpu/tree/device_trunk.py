@@ -37,12 +37,17 @@ from fluidframework_tpu.ops.tree_kernel import (
 
 
 class CommitBatch(NamedTuple):
-    """C sequenced commits for one document (stack for the scan)."""
+    """C sequenced commits for one document (stack for the scan).
+
+    ``seq``/``ref`` are DOCUMENT sequence numbers (sparse is fine — other
+    channels' ops consume seqs too); only their order matters. ``seq``
+    must be strictly increasing and > 0."""
 
     del_mask: jnp.ndarray  # int32[C, Lc]
     ins_cnt: jnp.ndarray  # int32[C, Lc+1]
     ins_ids: jnp.ndarray  # int32[C, Pc]
-    ref: jnp.ndarray  # int32[C] refSeq of each commit (seq k is 1-based)
+    ref: jnp.ndarray  # int32[C] refSeq of each commit
+    seq: jnp.ndarray  # int32[C] sequence number of each commit
 
 
 def _select(pred, a: DenseChange, b: DenseChange) -> DenseChange:
@@ -69,15 +74,17 @@ def trunk_scan(doc_ids, L, commits: CommitBatch, W: int):
     ring_seq = jnp.zeros(W, jnp.int32)  # 0 = empty slot
 
     def step(carry, inp):
-        (doc_ids, L, ring_del, ring_ins, ring_ids, ring_L, ring_seq, k,
-         err) = carry
+        (doc_ids, L, ring_del, ring_ins, ring_ids, ring_L, ring_seq,
+         max_evicted, err) = carry
         c = DenseChange(inp["del"], inp["ins"], inp["ids"])
         ref = inp["ref"]
-        # Ring-window guard: commit k rebases over trunk seqs [ref+1, k).
-        # The ring retains seqs [max(1, k-W), k); a needed seq was evicted
-        # iff ref+1 < k-W (vacuously false while k <= W+1), and the fold
-        # below would silently skip it.
-        err = err | (ref + 1 < k - W).astype(jnp.int32)
+        k = inp["seq"]
+        # Ring-window guard: the commit rebases over trunk seqs in
+        # (ref, k). If any already-evicted entry has seq > ref, the fold
+        # below would silently skip it — flag instead.
+        err = err | ((ref < max_evicted) & (max_evicted > 0)).astype(
+            jnp.int32
+        )
 
         # Fold over the ring oldest -> newest: rebase over every trunk
         # commit concurrent with this one (seq > ref). Inactive entries
@@ -91,7 +98,8 @@ def trunk_scan(doc_ids, L, commits: CommitBatch, W: int):
 
         c = jax.lax.fori_loop(0, W, fold, c)
         new_doc, new_L = apply_change(doc_ids, L, c)
-        # Push (c, L, seq=k) into the ring.
+        # Push (c, L, seq=k) into the ring; record the evicted seq.
+        max_evicted = jnp.maximum(max_evicted, ring_seq[0])
         ring_del = jnp.roll(ring_del, -1, axis=0).at[W - 1].set(c.del_mask)
         ring_ins = jnp.roll(ring_ins, -1, axis=0).at[W - 1].set(c.ins_cnt)
         ring_ids = jnp.roll(ring_ids, -1, axis=0).at[W - 1].set(c.ins_ids)
@@ -99,18 +107,19 @@ def trunk_scan(doc_ids, L, commits: CommitBatch, W: int):
         ring_seq = jnp.roll(ring_seq, -1).at[W - 1].set(k)
         return (
             new_doc, new_L, ring_del, ring_ins, ring_ids, ring_L,
-            ring_seq, k + 1, err,
+            ring_seq, max_evicted, err,
         ), None
 
     init = (
         doc_ids, L, ring_del, ring_ins, ring_ids, ring_L, ring_seq,
-        jnp.int32(1), jnp.int32(0),
+        jnp.int32(0), jnp.int32(0),
     )
     xs = {
         "del": commits.del_mask,
         "ins": commits.ins_cnt,
         "ids": commits.ins_ids,
         "ref": commits.ref,
+        "seq": commits.seq,
     }
     carry, _ = jax.lax.scan(step, init, xs)
     doc_ids, L, err = carry[0], carry[1], carry[-1]

@@ -1,1 +1,10 @@
+"""Shared small utilities."""
 
+
+def pow2_at_least(n: int) -> int:
+    """Smallest power of two >= n (shape bucketing: jit caches per shape,
+    so padded dims must come from a small closed set)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p

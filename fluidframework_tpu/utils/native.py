@@ -14,7 +14,10 @@ import os
 import subprocess
 from typing import Optional
 
-_NATIVE_DIR = os.path.join(
+# Native sources/binaries live in the repo's native/ sibling; deployments
+# that install the package elsewhere (e.g. the Dockerfile pip-installs
+# into site-packages but ships native/ at /app/native) point here:
+_NATIVE_DIR = os.environ.get("FLUID_NATIVE_DIR") or os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "native",
 )
