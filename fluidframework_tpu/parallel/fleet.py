@@ -47,6 +47,7 @@ from fluidframework_tpu.protocol.constants import (
     OP_WIDTH,
     RSEQ_NONE,
 )
+from fluidframework_tpu.utils import pow2_at_least as _pow2_at_least
 
 _SCALARS = ("count", "min_seq", "cur_seq", "self_client", "err")
 
@@ -79,7 +80,6 @@ def _np_batched_state(n_docs: int, capacity: int) -> SegmentState:
     )
 
 
-from fluidframework_tpu.utils import pow2_at_least as _pow2_at_least
 
 
 class _Pool:

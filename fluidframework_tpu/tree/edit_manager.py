@@ -230,15 +230,24 @@ class EditManager:
     def _device_prefix(self, commits: List[Commit], min_seq: int) -> int:
         if self.inflight != 0:
             return 0
+        # suffix_min_ref[i] = min ref over commits[i:] — one backward pass
+        # serves both the boundary fixpoint and the shrink below in O(N).
+        n = len(commits)
+        suffix_min_ref = [0] * (n + 1)
+        suffix_min_ref[n] = 1 << 62
+        for i in range(n - 1, -1, -1):
+            suffix_min_ref[i] = min(commits[i].ref, suffix_min_ref[i + 1])
         # B: the largest boundary <= min_seq no later commit rebases into.
+        # Seqs are increasing, so "commits with seq > B" is a suffix; walk
+        # the suffix start leftward as B lowers (amortized O(N)).
         b = min(min_seq, commits[-1].seq)
-        changed = True
-        while changed:
-            changed = False
-            for c in commits:
-                if c.seq > b and c.ref < b:
-                    b = c.ref
-                    changed = True
+        idx = n
+        while idx > 0 and commits[idx - 1].seq > b:
+            idx -= 1
+        while idx > 0 and suffix_min_ref[idx] < b:
+            b = suffix_min_ref[idx]
+            while idx > 0 and commits[idx - 1].seq > b:
+                idx -= 1
         base = self.trunk_seq
         if b <= base:
             return 0
@@ -258,14 +267,9 @@ class EditManager:
             prefix += 1
         # The fast path records no per-commit trunk forms, so NO remainder
         # commit may rebase into the prefix range either: shrink until
-        # every remainder ref >= the last prefix seq (fixpoint — shrinking
-        # moves commits into the remainder).
-        while prefix > 0:
-            min_rem_ref = min(
-                (c.ref for c in commits[prefix:]), default=None
-            )
-            if min_rem_ref is None or commits[prefix - 1].seq <= min_rem_ref:
-                break
+        # every remainder ref >= the last prefix seq (each check is O(1)
+        # via the precomputed suffix min).
+        while prefix > 0 and commits[prefix - 1].seq > suffix_min_ref[prefix]:
             prefix -= 1
         return prefix if prefix >= self.DEVICE_MIN_BATCH else 0
 
@@ -323,10 +327,11 @@ class EditManager:
                         p += 1
             refs[k] = c.ref
             seqs[k] = c.seq
-        # Identity padding: empty changes advancing seq keep shapes pow2.
+        # Identity padding: empty changes advancing seq keep shapes pow2
+        # (k >= len(commits) >= DEVICE_MIN_BATCH, so seqs[k-1] is set).
         for k in range(len(commits), C):
-            refs[k] = seqs[k - 1] if k else self.trunk_seq
-            seqs[k] = seqs[k - 1] + 1 if k else self.trunk_seq + 1
+            refs[k] = seqs[k - 1]
+            seqs[k] = seqs[k - 1] + 1
         ids0 = np.zeros((1, lc), np.int32)
         ids0[0, : len(doc)] = doc
         out_ids, out_L, err = batched_trunk_scan(
