@@ -263,6 +263,39 @@ class DocFleet:
         pool.state = jax.device_put(src_host)
         dst.state = jax.device_put(dst_host)
 
+    def overflowing_docs(self) -> List[int]:
+        """Docs above high water in a tier that cannot promote (cap*2 >
+        max_capacity) — the candidates for re-homing into a ShardedDoc
+        (intra-document scale-out) before ERR_CAPACITY trips."""
+        out: List[int] = []
+        for cap, pool in self.pools.items():
+            if cap * 2 <= self.max_capacity:
+                continue
+            counts = np.asarray(pool.state.count)
+            hot = np.flatnonzero(
+                (pool.doc_of_slot >= 0) & (counts > self.high_water * cap)
+            )
+            out.extend(int(pool.doc_of_slot[s]) for s in hot)
+        return out
+
+    def evict_doc(self, doc: int) -> SegmentState:
+        """Pull one document's state out of the fleet (host copy) and free
+        its slot — the hand-off half of ShardedDoc promotion. The doc id
+        stays allocated; routing it afterward is the caller's job."""
+        cap, slot = self.placement[doc]
+        pool = self.pools[cap]
+        state = self.doc_state(doc)
+        host = SegmentState(*[np.array(x) for x in pool.state])
+        empty = _np_batched_state(1, cap)
+        for lane in SEGMENT_LANES:
+            getattr(host, lane)[slot] = np.asarray(getattr(empty, lane))[0]
+        for s in _SCALARS:
+            getattr(host, s)[slot] = np.asarray(getattr(empty, s))[0]
+        pool.state = jax.device_put(host)
+        pool.doc_of_slot[slot] = -1
+        self.placement[doc] = None
+        return state
+
     # -- introspection --------------------------------------------------------
 
     def doc_state(self, doc: int) -> SegmentState:

@@ -51,12 +51,20 @@ class DeviceFleetBackend:
         max_batch: int = 512,
         compact_every: int = 8,
         max_capacity: int = 1 << 16,
+        sharded_overflow: bool = False,
     ):
         self.fleet = DocFleet(
             0, capacity, max_capacity=max_capacity
         )
         self.max_batch = max_batch
         self.compact_every = compact_every
+        # Overflow policy: a channel that outgrows the largest fleet tier
+        # either errors (429 nack — the conservative default: a ShardedDoc
+        # spreads ONE document over the whole mesh, a deliberate
+        # allocation) or re-homes into a ShardedDoc for intra-document
+        # scale-out (SURVEY §5.7; VERDICT r2 do #4 reachability).
+        self.sharded_overflow = sharded_overflow
+        self._sharded: Dict[int, object] = {}  # fleet idx -> ShardedDoc
         self._index: Dict[ChannelKey, int] = {}
         self._keys: List[ChannelKey] = []  # dense fleet id -> key
         self.payloads: Dict[ChannelKey, dict] = {}
@@ -129,23 +137,73 @@ class DeviceFleetBackend:
             k = max(len(r) for r in take.values())
             k = _pow2_at_least(max(k, 8))
             ops = np.zeros((self.fleet.n_docs, k, OP_WIDTH), np.int32)
+            sharded_rows: Dict[int, List[np.ndarray]] = {}
+            fleet_rows = False
             for idx, rows in take.items():
-                ops[idx, : len(rows)] = rows
+                if idx in self._sharded:
+                    sharded_rows[idx] = rows
+                else:
+                    ops[idx, : len(rows)] = rows
+                    fleet_rows = True
                 key = self._keys[idx]
                 self.applied_seq[key] = max(
                     self.applied_seq[key], int(rows[-1][F_SEQ])
                 )
                 self.ops_since_summary[key] += len(rows)
                 self.ops_applied += len(rows)
-            self.fleet.apply(ops)
-            self.fleet.check_and_migrate()
+            if fleet_rows:
+                self.fleet.apply(ops)
+                self.fleet.check_and_migrate()
+                if self.sharded_overflow:
+                    self._promote_overflow()
             self._flushes += 1
-            if self._flushes % self.compact_every == 0:
+            compact_now = self._flushes % self.compact_every == 0
+            for idx, rows in sharded_rows.items():
+                doc = self._sharded[idx]
+                # Pad K to the same pow2 buckets as the fleet path (zero
+                # rows are NOOPs) — unpadded shapes would recompile the
+                # shard_map scan per distinct row count.
+                kk = _pow2_at_least(max(len(rows), 8))
+                padded = np.zeros((kk, OP_WIDTH), np.int32)
+                padded[: len(rows)] = rows
+                doc.apply(padded)
+                if compact_now:
+                    doc.compact()
+                doc.rebalance()  # self-compacts when it triggers
+            if compact_now:
                 self.fleet.compact()
             newly_errored.extend(self._collect_errors())
         self._buffered_rows = 0
         self._unreported.extend(newly_errored)
         return newly_errored
+
+    def _promote_overflow(self) -> None:
+        """Re-home docs that outgrew the top fleet tier into ShardedDocs
+        (segment table spread over the device mesh, collective prefix
+        sums resolving positions — parallel/sharded_doc.py)."""
+        import jax
+
+        from fluidframework_tpu.parallel.sharded_doc import ShardedDoc
+
+        if not self.fleet.overflowing_docs():
+            return
+        # Promotion is irreversible and allocates the whole mesh to one
+        # document — reclaim tombstones first so only genuinely LIVE
+        # growth promotes.
+        self.fleet.compact()
+        for idx in self.fleet.overflowing_docs():
+            state = self.fleet.evict_doc(idx)
+            # Total sharded capacity targets 8x the top fleet tier
+            # regardless of mesh size (a 1-device mesh must still GROW the
+            # document, not just re-home it).
+            n_dev = len(jax.devices())
+            shard_cap = max(
+                self.fleet.max_capacity,
+                (8 * self.fleet.max_capacity) // n_dev,
+            )
+            doc = ShardedDoc(shard_cap=shard_cap)
+            doc.load_single(state)
+            self._sharded[idx] = doc
 
     def _collect_errors(self) -> List[ChannelKey]:
         out: List[ChannelKey] = []
@@ -157,7 +215,16 @@ class DeviceFleetBackend:
                 if idx not in self._errored:
                     self._errored.add(idx)
                     out.append(self._keys[idx])
+        for idx, doc in self._sharded.items():
+            if doc.err != 0 and idx not in self._errored:
+                self._errored.add(idx)
+                out.append(self._keys[idx])
         return out
+
+    def _doc_state(self, idx: int):
+        if idx in self._sharded:
+            return self._sharded[idx].to_single()
+        return self.fleet.doc_state(idx)
 
     # -- the read path ---------------------------------------------------------
 
@@ -167,7 +234,7 @@ class DeviceFleetBackend:
         if key not in self._index:
             return ""
         self.flush()
-        state = self.fleet.doc_state(self._index[key])
+        state = self._doc_state(self._index[key])
         return materialize(state, self.payloads[key])
 
     def channel_summary(self, doc_id: str, address: str) -> Optional[dict]:
@@ -178,7 +245,7 @@ class DeviceFleetBackend:
         if key not in self._index:
             return None
         self.flush()
-        h = self.fleet.doc_state(self._index[key])
+        h = self._doc_state(self._index[key])
         n = int(h.count)
         self.ops_since_summary[key] = 0
         return {
@@ -208,10 +275,17 @@ class DeviceFleetBackend:
 
     def stats(self) -> dict:
         s = self.fleet.stats()
+        s["docs_with_errors"] += sum(
+            1 for d in self._sharded.values() if d.err != 0
+        )
         s.update(
             channels=len(self._keys),
             ops_applied=self.ops_applied,
             buffered=self._buffered_rows,
             flushes=self._flushes,
+            sharded_docs=len(self._sharded),
+            sharded_rows=sum(
+                d.rows_in_use() for d in self._sharded.values()
+            ),
         )
         return s

@@ -89,6 +89,7 @@ class PipelineFluidService:
         device_backend: bool = True,
         device_capacity: int = 128,
         device_max_capacity: int = 1 << 16,
+        device_sharded_overflow: bool = False,
     ):
         self.log = PartitionedLog(n_partitions)
         self.store = SummaryStore()
@@ -126,18 +127,24 @@ class PipelineFluidService:
         self.device: Optional[Any] = None
         self._device_runner: Optional[PartitionRunner] = None
         if device_backend:
-            self._make_device(device_capacity, device_max_capacity)
+            self._make_device(
+                device_capacity, device_max_capacity,
+                device_sharded_overflow,
+            )
 
-    def _make_device(self, capacity: int, max_capacity: int) -> None:
+    def _make_device(
+        self, capacity: int, max_capacity: int, sharded_overflow: bool
+    ) -> None:
         from fluidframework_tpu.service.device_backend import (
             DeviceFleetBackend,
         )
         from fluidframework_tpu.service.device_lambda import TpuDeliLambda
 
         self.device = DeviceFleetBackend(
-            capacity=capacity, max_capacity=max_capacity
+            capacity=capacity, max_capacity=max_capacity,
+            sharded_overflow=sharded_overflow,
         )
-        self._device_capacity = (capacity, max_capacity)
+        self._device_capacity = (capacity, max_capacity, sharded_overflow)
 
         def factory(p: int, state):
             return DocumentLambda(

@@ -33,6 +33,7 @@ DEFAULTS: Dict[str, Any] = {
     "device_backend": True,
     "device_capacity": 128,
     "device_max_capacity": 1 << 16,
+    "device_sharded_overflow": False,
     "tenants": {},  # tenant id -> shared key (riddler table); {} = open
 }
 
@@ -81,6 +82,7 @@ def build_server(cfg: Dict[str, Any]):
         device_backend=cfg["device_backend"],
         device_capacity=cfg["device_capacity"],
         device_max_capacity=cfg["device_max_capacity"],
+        device_sharded_overflow=cfg["device_sharded_overflow"],
     )
     tenants = None
     if cfg["tenants"]:
