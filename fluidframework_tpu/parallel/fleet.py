@@ -212,10 +212,7 @@ class DocFleet:
             pool = self.pools[cap]
             if cap * 2 > self.max_capacity:
                 continue
-            counts = np.asarray(pool.state.count)
-            hot_slots = np.flatnonzero(
-                (pool.doc_of_slot >= 0) & (counts > self.high_water * cap)
-            )
+            hot_slots = self._hot_slots(pool, cap)
             hot = [(int(s), int(pool.doc_of_slot[s])) for s in hot_slots]
             if not hot:
                 continue
@@ -263,19 +260,31 @@ class DocFleet:
         pool.state = jax.device_put(src_host)
         dst.state = jax.device_put(dst_host)
 
+    def _hot_slots(self, pool: _Pool, cap: int) -> np.ndarray:
+        """Live slots above the high-water mark — the single promotion
+        predicate shared by tier promotion and sharded-overflow scans."""
+        counts = np.asarray(pool.state.count)
+        return np.flatnonzero(
+            (pool.doc_of_slot >= 0) & (counts > self.high_water * cap)
+        )
+
     def overflowing_docs(self) -> List[int]:
-        """Docs above high water in a tier that cannot promote (cap*2 >
-        max_capacity) — the candidates for re-homing into a ShardedDoc
-        (intra-document scale-out) before ERR_CAPACITY trips."""
+        """Healthy docs above high water in a tier that cannot promote
+        (cap*2 > max_capacity) — the candidates for re-homing into a
+        ShardedDoc (intra-document scale-out) before ERR_CAPACITY trips.
+        Docs whose sticky err lane already fired are excluded: they have
+        dropped ops, and re-homing corrupt state would launder the error —
+        they stay in the fleet and keep nacking."""
         out: List[int] = []
         for cap, pool in self.pools.items():
             if cap * 2 <= self.max_capacity:
                 continue
-            counts = np.asarray(pool.state.count)
-            hot = np.flatnonzero(
-                (pool.doc_of_slot >= 0) & (counts > self.high_water * cap)
+            err = np.asarray(pool.state.err)
+            out.extend(
+                int(pool.doc_of_slot[s])
+                for s in self._hot_slots(pool, cap)
+                if err[s] == 0
             )
-            out.extend(int(pool.doc_of_slot[s]) for s in hot)
         return out
 
     def evict_doc(self, doc: int) -> SegmentState:

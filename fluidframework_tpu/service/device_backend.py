@@ -130,9 +130,20 @@ class DeviceFleetBackend:
             take: Dict[int, List[np.ndarray]] = {}
             rest: Dict[int, List[np.ndarray]] = {}
             for idx, rows in self._buffers.items():
-                take[idx] = rows[: self.max_batch]
-                if len(rows) > self.max_batch:
-                    rest[idx] = rows[self.max_batch:]
+                # Fleet docs chunk to their tier's promotion headroom: a
+                # burst must not cross high_water AND overflow in one
+                # dispatch — growth promotes tier-by-tier between rounds
+                # (fleet.py's stated capacity contract).
+                limit = self.max_batch
+                if idx not in self._sharded:
+                    cap = self.fleet.placement[idx][0]
+                    limit = min(
+                        limit,
+                        max(1, int((1 - self.fleet.high_water) * cap)),
+                    )
+                take[idx] = rows[:limit]
+                if len(rows) > limit:
+                    rest[idx] = rows[limit:]
             self._buffers = rest
             k = max(len(r) for r in take.values())
             k = _pow2_at_least(max(k, 8))
@@ -197,10 +208,7 @@ class DeviceFleetBackend:
             # regardless of mesh size (a 1-device mesh must still GROW the
             # document, not just re-home it).
             n_dev = len(jax.devices())
-            shard_cap = max(
-                self.fleet.max_capacity,
-                (8 * self.fleet.max_capacity) // n_dev,
-            )
+            shard_cap = -(-8 * self.fleet.max_capacity // n_dev)
             doc = ShardedDoc(shard_cap=shard_cap)
             doc.load_single(state)
             self._sharded[idx] = doc

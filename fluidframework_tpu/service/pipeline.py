@@ -384,6 +384,17 @@ class ReservationManager:
             return True
         return False
 
+    def release(self, node: str, doc_id: str) -> bool:
+        """Voluntary lease surrender (load-driven migration): the holder
+        expires its own lease so another node can acquire immediately —
+        the acquire still bumps the fencing epoch, so any straggling write
+        from the old owner is rejected exactly as after a TTL lapse."""
+        lease = self._leases.get(doc_id)
+        if lease and lease["node"] == node:
+            lease["expires"] = self._clock()
+            return True
+        return False
+
     def holder(self, doc_id: str) -> Optional[str]:
         lease = self._leases.get(doc_id)
         if lease and lease["expires"] > self._clock():

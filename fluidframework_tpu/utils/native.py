@@ -162,6 +162,10 @@ def _load_coord() -> Optional[ctypes.CDLL]:
     ]
     lib.coord_epoch.restype = ctypes.c_int64
     lib.coord_epoch.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.coord_release.restype = ctypes.c_int
+    lib.coord_release.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+    ]
     return lib
 
 
@@ -207,6 +211,15 @@ class NativeCoordination:
             self._h, doc_id.encode(), self._now_ms(), out, 256
         )
         return out.raw[:n].decode() if n >= 0 else None
+
+    def release(self, node: str, doc_id: str) -> bool:
+        """Voluntary surrender for load migration (same fencing as a TTL
+        lapse — the next acquire bumps the epoch)."""
+        return bool(
+            self._lib.coord_release(
+                self._h, node.encode(), doc_id.encode(), self._now_ms()
+            )
+        )
 
     def epoch(self, doc_id: str) -> int:
         return int(self._lib.coord_epoch(self._h, doc_id.encode()))

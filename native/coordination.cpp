@@ -127,6 +127,21 @@ int64_t coord_holder(void* h, const char* doc, int64_t now_ms, char* out,
   return (int64_t)it->second.node.size();
 }
 
+// Voluntary lease surrender (load-driven migration): the holder expires
+// its own lease so another node can acquire immediately; the next acquire
+// still bumps the epoch, so stale writes fence exactly as after a lapse.
+// Returns 1 when the caller held the lease.
+int coord_release(void* h, const char* node, const char* doc,
+                  int64_t now_ms) {
+  Coord* c = static_cast<Coord*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  auto it = c->leases.find(doc);
+  if (it == c->leases.end() || it->second.node != node) return 0;
+  it->second.expires_ms = now_ms;
+  c->persist(doc, it->second);
+  return 1;
+}
+
 int64_t coord_epoch(void* h, const char* doc) {
   Coord* c = static_cast<Coord*>(h);
   std::lock_guard<std::mutex> lk(c->mu);

@@ -205,6 +205,31 @@ def test_fleet_overflow_promotes_into_sharded_doc():
     assert svc.device_text("doc", "s") == s.get_text()
 
 
+def test_burst_promotes_without_tripping_err():
+    """A single-flush burst past the top tier must promote cleanly: flush
+    chunks fleet docs to their tier's promotion headroom, so growth walks
+    the lifecycle instead of overflowing one dispatch (and an erred doc is
+    never promoted — re-homing corrupt state would launder the error)."""
+    from fluidframework_tpu.models.shared_string import SharedString
+    from fluidframework_tpu.runtime.container import ContainerRuntime
+    from fluidframework_tpu.service.pipeline import PipelineFluidService
+
+    svc = PipelineFluidService(
+        n_partitions=2, device_capacity=8, device_max_capacity=8,
+        device_sharded_overflow=True,
+    )
+    a = ContainerRuntime(svc, "doc", channels=(SharedString("s"),))
+    s = a.get_channel("s")
+    for i in range(14):  # buffered as ONE burst — no per-op drain
+        s.insert_text(0, chr(ord("a") + i))
+    a.flush()
+    a.process_incoming()
+    stats = svc.device.stats()
+    assert stats["docs_with_errors"] == 0, stats
+    assert stats["sharded_docs"] == 1, stats
+    assert svc.device_text("doc", "s") == s.get_text()
+
+
 def test_global_out_of_range_flags_err():
     # ERR_RANGE must fire on GLOBAL coordinates — per-shard clamping alone
     # would silently legalize invalid streams the single-device kernel
