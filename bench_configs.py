@@ -564,8 +564,14 @@ def config6_big_docs(n_docs: int, target_rows: int, on_tpu: bool) -> None:
             break
     iters = 3
     t0 = time.perf_counter()
+    t_routing = 0.0
+    t_gen = 0.0
     for _ in range(iters):
-        fleet.apply(round_ops(grow=False))
+        tg = time.perf_counter()
+        ops = round_ops(grow=False)
+        t_gen += time.perf_counter() - tg
+        fleet.apply(ops)
+        t_routing += fleet.last_routing_s
         fleet.compact()
         fleet.check_and_migrate()
     stats = fleet.stats()
@@ -577,6 +583,8 @@ def config6_big_docs(n_docs: int, target_rows: int, on_tpu: bool) -> None:
         unit="ops/s", config=6, n_docs=n_docs,
         live_rows_per_doc=rows_now, capacity_tiers=stats["pools"],
         migrations=stats["migrations"], errs=stats["docs_with_errors"],
+        routing_s=round(t_routing, 3), gen_s=round(t_gen, 3),
+        routing_pct=round(100 * t_routing / dt, 1),
     )
 
 
@@ -625,9 +633,13 @@ def main() -> None:
             on_tpu=on_tpu,
         )
     if args.config in (0, 6):
+        # >=10k docs so the lifecycle's HOST cost (routing gathers, count
+        # readbacks, migration copies) is a measured number at fleet scale
+        # (VERDICT r2 do #7); target_rows keeps per-doc tables realistic
+        # while total device footprint stays within one chip.
         config6_big_docs(
-            n_docs=256 if full else 8,
-            target_rows=4096 if full else 256,
+            n_docs=10_240 if full else 8,
+            target_rows=1024 if full else 256,
             on_tpu=on_tpu,
         )
 

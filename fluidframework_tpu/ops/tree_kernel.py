@@ -25,6 +25,25 @@ pool untouched (the boundary mapping is monotone).
 Tie policy matches ``marks.py``: rebasing the LATER-sequenced change puts
 its inserts before the earlier change's inserts at the same boundary
 (``c_after=False``); ``c_after=True`` mirrors.
+
+Mark coverage is {skip, del, ins} — a CONTRACT, not a silent gap. The
+reference sequence-field IR additionally has ``MoveOut/MoveIn/Revive``
+with lineage (``sequence-field/format.ts:14-220``); this framework
+re-designs both away from the positional IR:
+
+- **moves** are identity reattaches in the hierarchical layer
+  (``tree/hierarchy.py:191`` ``_move`` — cycle-guarded, tombstone +
+  live-entry semantics), so no positional move mark ever reaches a
+  sequence-field stream;
+- **revive** is value-carrying delete inversion: ``del`` marks carry
+  their values (``tree/marks.py:13``), so ``invert`` re-inserts the
+  SAME ids — pinned on-device by
+  ``test_tree_kernel.py::test_invert_roundtrip_on_device`` and
+  ``test_revive_restores_identical_ids``.
+
+Streams bearing any other mark kind are rejected by ``from_marks`` and
+excluded from the EditManager device prefix (host fallback), both
+exercised by tests.
 """
 
 from __future__ import annotations
@@ -309,10 +328,15 @@ def from_marks(marks, Lc: int, Pc: int) -> Tuple[DenseChange, int]:
         elif t == "del":
             del_mask[i : i + len(v)] = 1
             i += len(v)
-        else:
+        elif t == "ins":
             ins_cnt[i] += len(v)
             ins_ids[p : p + len(v)] = v
             p += len(v)
+        else:
+            from fluidframework_tpu.tree.marks import _check_kind
+
+            _check_kind(t)  # raises: outside the shared mark vocabulary
+            raise AssertionError(f"unlowered mark kind {t!r}")
     return DenseChange(del_mask, ins_cnt, ins_ids), i
 
 

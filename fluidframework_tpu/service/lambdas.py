@@ -181,9 +181,17 @@ class DeliDocLambda(PartitionLambda):
         self.doc_id = doc_id
         checkpoint = None
         self._signal_counter = 0
+        # Monotone dedupe floor per service-signal group: an upstream
+        # service lambda (foreman) replaying under at-least-once delivery
+        # re-emits signals it already sent; each carries a ``basis`` (the
+        # sequenced message that caused it), and deli drops any at or
+        # below the group's floor — exactly-once effect without the
+        # emitter needing its own durable send state.
+        self._signal_basis: Dict[str, int] = {}
         if state is not None:
             checkpoint = SequencerCheckpoint(**state["sequencer"])
             self._signal_counter = state["signals"]
+            self._signal_basis = dict(state.get("signal_basis", {}))
         self.sequencer = DocumentSequencer(doc_id, checkpoint)
 
     def state(self) -> dict:
@@ -198,6 +206,7 @@ class DeliDocLambda(PartitionLambda):
                 "connection_count": cp.connection_count,
             },
             "signals": self._signal_counter,
+            "signal_basis": dict(self._signal_basis),
         }
 
     def handler(self, key: str, value: dict) -> List[Tuple[str, str, Any]]:
@@ -253,6 +262,12 @@ class DeliDocLambda(PartitionLambda):
             )
             out.append((DELTAS_TOPIC, key, {"t": "seq", "msg": ack}))
         elif t == "signal":
+            group = value.get("group")
+            if group is not None:
+                basis = value["basis"]
+                if basis <= self._signal_basis.get(group, 0):
+                    return out  # replayed service signal: already sent
+                self._signal_basis[group] = basis
             self._signal_counter += 1
             out.append(
                 (SIGNALS_TOPIC, key,

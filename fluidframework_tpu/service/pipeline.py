@@ -90,6 +90,7 @@ class PipelineFluidService:
         device_capacity: int = 128,
         device_max_capacity: int = 1 << 16,
         device_sharded_overflow: bool = False,
+        foreman_tasks: tuple = ("summarizer",),
     ):
         self.log = PartitionedLog(n_partitions)
         self.store = SummaryStore()
@@ -119,6 +120,26 @@ class PipelineFluidService:
             lambda p, s: SignalBroadcasterLambda(self.rooms),
             self.checkpoints, checkpoint_every,
         )
+        # Foreman: service-side task assignment on the sequenced stream
+        # (reference lambdas/src/foreman/lambda.ts:20); assignments ride
+        # back through deli as service-originated signals.
+        self._foreman: Optional[PartitionRunner] = None
+        if foreman_tasks:
+            from fluidframework_tpu.service.foreman import ForemanDocLambda
+
+            def foreman_factory(p: int, state):
+                lam = DocumentLambda(
+                    lambda doc_id, s: ForemanDocLambda(
+                        doc_id, s, tasks=tuple(foreman_tasks)
+                    )
+                )
+                lam.restore_docs(state)
+                return lam
+
+            self._foreman = PartitionRunner(
+                self.log, DELTAS_TOPIC, "foreman", foreman_factory,
+                self.checkpoints, checkpoint_every,
+            )
         # The device-apply stage (TpuDeliLambda): the service's replica of
         # every string channel lives in a DocFleet on the accelerator.
         # Deliberately NOT in self.checkpoints — its durable form is the
@@ -192,8 +213,11 @@ class PipelineFluidService:
         self._scribe = self._make_scribe(checkpoint_every)
 
     def checkpoint_all(self) -> None:
-        for r in (self._deli, self._scribe, self._scriptorium,
-                  self._broadcaster, self._signals):
+        runners = [self._deli, self._scribe, self._scriptorium,
+                   self._broadcaster, self._signals]
+        if self._foreman is not None:
+            runners.append(self._foreman)
+        for r in runners:
             r.checkpoint()
 
     # -- the pipeline pump -----------------------------------------------------
@@ -212,6 +236,8 @@ class PipelineFluidService:
             )
             if self._device_runner is not None:
                 n += self._device_runner.pump()
+            if self._foreman is not None:
+                n += self._foreman.pump()
             total += n
             if n == 0:
                 # Quiescent: boxcar any freshly buffered device rows and

@@ -259,6 +259,13 @@ class EditManager:
                 break
             if c.ref < prev_seq:  # concurrent: host path (see docstring)
                 break
+            if any(t not in M.MARK_KINDS for t, _v in c.change):
+                # Mark kinds beyond the dense IR (the reference sequence-
+                # field also has MoveOut/MoveIn/Revive, format.ts:14-220;
+                # here moves ride the hierarchical identity layer and
+                # revive is value-carrying delete inversion) fall back to
+                # the host path BY CONTRACT — never silently miscompiled.
+                break
             n_ins = sum(len(v) for t, v in c.change if t == "ins")
             total_ins += n_ins
             if total_ins + 8 > self.DEVICE_MAX_LC:

@@ -32,6 +32,22 @@ from typing import Any, List, Tuple
 Mark = Tuple[str, Any]
 Changeset = List[Mark]
 
+# The complete mark vocabulary of this IR — shared with the dense device
+# lowering (ops/tree_kernel.from_marks) and the EditManager device-prefix
+# gate. The reference sequence-field IR additionally has MoveOut/MoveIn/
+# Revive (format.ts:14-220); here moves ride the hierarchical identity
+# layer and revive is value-carrying delete inversion, so anything else
+# is rejected loudly rather than silently treated as an insert.
+MARK_KINDS = ("skip", "del", "ins")
+
+
+def _check_kind(t: str) -> None:
+    if t not in MARK_KINDS:
+        raise ValueError(
+            f"mark kind {t!r} is outside the sequence-field IR "
+            "({skip, del, ins}); moves belong to the hierarchical layer"
+        )
+
 
 def skip(n: int) -> Mark:
     return ("skip", n)
@@ -72,6 +88,7 @@ def normalize(c: Changeset) -> Changeset:
     """Merge adjacent same-type runs, drop empties and trailing skips."""
     out: Changeset = []
     for t, v in c:
+        _check_kind(t)
         if t == "skip" and v == 0:
             continue
         if t in ("del", "ins") and not v:
@@ -93,6 +110,7 @@ def apply(state: list, c: Changeset) -> list:
     out: list = []
     i = 0
     for t, v in c:
+        _check_kind(t)
         if t == "skip":
             out.extend(state[i : i + v])
             i += v
@@ -111,6 +129,7 @@ def invert(c: Changeset) -> Changeset:
     """Inverse changeset (over c's output document)."""
     out: Changeset = []
     for t, v in c:
+        _check_kind(t)
         if t == "skip":
             out.append(("skip", v))
         elif t == "del":

@@ -146,6 +146,61 @@ def test_rebase_insert_inside_deleted_range_slides_on_device():
     assert TK.dense_to_doc(out[0], oL[0]) == [1, 9, 4]
 
 
+def test_revive_restores_identical_ids():
+    """Revive semantics (reference Revive/ReturnTo marks): del marks carry
+    values, so inverting a delete re-inserts the SAME ids at the same
+    spots — the detached-content round-trip, through the device kernel."""
+    s = [11, 22, 33, 44]
+    c = [M.skip(1), M.delete([22, 33])]
+    ids, L = TK.doc_to_dense(s, LC)
+    Lb = np.asarray([L], np.int32)
+    dc = tree_map_batch(dense(c)[0])
+    out, out_L = TK.batched_apply(ids[None], Lb, dc)
+    assert TK.dense_to_doc(out[0], out_L[0]) == [11, 44]
+    inv = TK.batched_invert(ids[None], Lb, dc)
+    back, back_L = TK.batched_apply(out, out_L, inv)
+    # Identity, not just equal values: the revived cells ARE 22 and 33.
+    assert TK.dense_to_doc(back[0], back_L[0]) == [11, 22, 33, 44]
+
+
+def test_unknown_mark_kind_is_rejected_loudly():
+    """Move-bearing (or any non-{skip,del,ins}) streams must be refused by
+    the dense lowering — the contract replacing the reference's
+    MoveOut/MoveIn marks (handled here by the hierarchical identity
+    layer), never a silent miscompile."""
+    with pytest.raises(ValueError, match="outside the sequence-field IR"):
+        TK.from_marks([("mvout", [1, 2])], LC, PC)
+    # The host algebra rejects them too — never silently insert-coerced.
+    with pytest.raises(ValueError, match="outside the sequence-field IR"):
+        M.apply([1, 2], [("mvout", [1])])
+    with pytest.raises(ValueError, match="outside the sequence-field IR"):
+        M.invert([("revive", [1])])
+
+
+def test_move_bearing_commit_falls_back_to_host_path():
+    """EditManager's device prefix excludes commits with unknown mark
+    kinds: they take the host path by contract."""
+    from fluidframework_tpu.tree.edit_manager import Commit, EditManager
+
+    em = EditManager(session=1)
+    commits = [
+        Commit(session=7, seq=k, ref=k - 1,
+               change=[M.insert([(1000 + k, k)])])
+        for k in range(1, 6)
+    ]
+    # A foreign mark kind mid-stream (simulating a future move wire form).
+    commits[2] = Commit(
+        session=7, seq=3, ref=2,
+        change=[("mvout", [(1001, 1)])],
+    )
+    assert em._device_prefix(commits, min_seq=5) == 0  # stops before it
+    # The same stream without the foreign mark is device-eligible.
+    commits[2] = Commit(
+        session=7, seq=3, ref=2, change=[M.insert([(1003, 3)])]
+    )
+    assert em._device_prefix(commits, min_seq=5) == 5
+
+
 def test_compose_pool_overflow_flagged():
     """Composing changes whose merged live inserts exceed Pc must raise the
     overflow lane instead of silently truncating (ADVICE r2)."""
