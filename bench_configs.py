@@ -547,10 +547,8 @@ def config6_big_docs(n_docs: int, target_rows: int, on_tpu: bool) -> None:
         fleet.apply(round_ops(grow=True))
         fleet.compact()
         fleet.check_and_migrate()
-        counts = [
-            int(np.asarray(fleet.doc_state(d).count)) for d in range(scripts)
-        ]
-        if min(counts) >= target_rows:
+        counts = fleet.doc_counts(list(range(scripts)))
+        if int(counts.min()) >= target_rows:
             break
     stats = fleet.stats()
     assert stats["docs_with_errors"] == 0, stats
@@ -634,12 +632,17 @@ def main() -> None:
         )
     if args.config in (0, 6):
         # >=10k docs so the lifecycle's HOST cost (routing gathers, count
-        # readbacks, migration copies) is a measured number at fleet scale
-        # (VERDICT r2 do #7); target_rows keeps per-doc tables realistic
-        # while total device footprint stays within one chip.
+        # readbacks, migration copies) is a measured number at fleet scale.
+        # One promotion wave (256->512) at fleet scale: each new pool
+        # shape costs ~30-60s of tunnel compile, and sustained multi-wave
+        # runs have crashed the tunneled TPU worker twice; the deep
+        # many-tier lifecycle stays covered by the r2 256-doc/4263-row
+        # shape and the CI shape every run. (A 128 start tier underflows
+        # this generator: ~30 inserts/round plus splits can outgrow the
+        # 0.3*128-row promotion headroom inside one boxcar.)
         config6_big_docs(
             n_docs=10_240 if full else 8,
-            target_rows=1024 if full else 256,
+            target_rows=320 if full else 256,
             on_tpu=on_tpu,
         )
 

@@ -58,6 +58,36 @@ _jit_step = jax.jit(batched_apply_ops, donate_argnums=(0,))
 _jit_compact = jax.jit(batched_compact, donate_argnums=(0,))
 
 
+def _pallas_step(state: SegmentState, ops) -> SegmentState:
+    """Pallas engine for fleet pools: grid-of-blocks compilation keeps the
+    per-program unit small — the monolithic XLA scan at 16k-slot shapes
+    has crashed the tunneled TPU compile helper."""
+    from fluidframework_tpu.ops.pallas_kernel import pallas_batched_apply_ops
+
+    return pallas_batched_apply_ops(state, ops, block_docs=32)
+
+
+def _pallas_compact_step(state: SegmentState) -> SegmentState:
+    # The compact kernel's [blk, cap, cap] permutation transport forces
+    # blk below Mosaic's 8-row floor past cap 256 — big tiers compact via
+    # the XLA scatter formulation instead (no cap^2 intermediates).
+    if state.kind.shape[-1] > 256:
+        return _jit_compact(state)
+    from fluidframework_tpu.ops.pallas_compact import pallas_batched_compact
+
+    return pallas_batched_compact(state, block_docs=32)
+
+
+def _resolve_kernel(kernel: str) -> str:
+    if kernel == "auto":
+        return "xla" if jax.default_backend() in ("cpu", "gpu") else "pallas"
+    if kernel not in ("xla", "pallas"):
+        raise ValueError(
+            f"kernel must be 'auto', 'xla', or 'pallas'; got {kernel!r}"
+        )
+    return kernel
+
+
 def _np_batched_state(n_docs: int, capacity: int) -> SegmentState:
     """Empty batched state as HOST numpy. Pool assembly (init, slot
     growth, migration) must not run eager jnp ops — each new shape would
@@ -88,13 +118,17 @@ class _Pool:
     ``doc_of_slot`` is an int32 array (-1 = free) so batch routing is a
     vectorized gather, not a Python slot loop (VERDICT r2 Weak #4)."""
 
-    def __init__(self, capacity: int, n_slots: int):
+    def __init__(self, capacity: int, n_slots: int, kernel: str = "xla"):
         self.capacity = capacity
         self.n_slots = n_slots
         self.state = jax.device_put(_np_batched_state(n_slots, capacity))
         self.doc_of_slot = np.full(n_slots, -1, np.int32)
-        self._step = _jit_step
-        self._compact = _jit_compact
+        if kernel == "pallas":
+            self._step = _pallas_step
+            self._compact = _pallas_compact_step
+        else:
+            self._step = _jit_step
+            self._compact = _jit_compact
 
     def free_slot(self) -> Optional[int]:
         free = np.flatnonzero(self.doc_of_slot < 0)
@@ -136,13 +170,17 @@ class DocFleet:
         capacity: int,
         high_water: float = 0.75,
         max_capacity: int = 1 << 16,
+        kernel: str = "auto",
     ):
         self.n_docs = n_docs
         self.high_water = high_water
         self.max_capacity = max_capacity
         self.base_capacity = capacity
+        # Kernel engine: "pallas" (VMEM blocks — the TPU default) or
+        # "xla" (vmapped scan — the CPU/test default under "auto").
+        self.kernel = _resolve_kernel(kernel)
         n_slots = _pow2_at_least(n_docs)
-        pool = _Pool(capacity, n_slots)
+        pool = _Pool(capacity, n_slots, self.kernel)
         pool.doc_of_slot[:n_docs] = np.arange(n_docs)
         self.pools: Dict[int, _Pool] = {capacity: pool}
         self.placement: List[Tuple[int, int]] = [
@@ -160,7 +198,7 @@ class DocFleet:
         pool = self.pools.get(self.base_capacity)
         if pool is None:
             pool = self.pools[self.base_capacity] = _Pool(
-                self.base_capacity, 1
+                self.base_capacity, 1, self.kernel
             )
         slot = pool.free_slot()
         if slot is None:
@@ -235,7 +273,7 @@ class DocFleet:
         dst = self.pools.get(new_cap)
         if dst is None:
             dst = self.pools[new_cap] = _Pool(
-                new_cap, _pow2_at_least(len(hot))
+                new_cap, _pow2_at_least(len(hot)), self.kernel
             )
         while dst.n_free() < len(hot):
             dst.grow_slots()
@@ -313,6 +351,27 @@ class DocFleet:
         return state
 
     # -- introspection --------------------------------------------------------
+
+    def doc_counts(self, docs: List[int]) -> np.ndarray:
+        """Live row counts for a set of docs with ONE [n_slots] count-lane
+        readback per pool — ``doc_state`` per doc would pull every lane of
+        the whole pool through the transfer path. Docs evicted out of the
+        fleet (ShardedDoc promotion) report 0: their rows live elsewhere
+        (``DeviceFleetBackend.stats`` aggregates them)."""
+        count_cache: Dict[int, np.ndarray] = {}
+        out = np.zeros(len(docs), np.int32)
+        for i, d in enumerate(docs):
+            place = self.placement[d]
+            if place is None:
+                continue  # evicted to a ShardedDoc
+            cap, slot = place
+            counts = count_cache.get(cap)
+            if counts is None:
+                counts = count_cache[cap] = np.asarray(
+                    self.pools[cap].state.count
+                )
+            out[i] = counts[slot]
+        return out
 
     def doc_state(self, doc: int) -> SegmentState:
         cap, slot = self.placement[doc]

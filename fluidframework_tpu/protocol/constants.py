@@ -54,6 +54,19 @@ OP_WIDTH = 10
 # stores removedClientIds as a list (mergeTreeNodes.ts) with a 1M-client
 # config cap; 62 *concurrent* writers per document with slot recycling
 # (service/sequencer.py) covers the same sessions over time.
+#
+# SCALING STORY (the formal contract for this ceiling): the cap counts
+# SIMULTANEOUS write connections to ONE document, not sessions — slots
+# recycle on leave (sequencer.py:96-137), writer 63 gets a clean
+# ERR_CLIENT + nack rather than corruption, and read connections are
+# unlimited. Widening is mechanical and O(lanes): each extra int32 lane
+# (rbits3, ...) adds 31 slots at a cost of one [D, S] lane (~4 bytes/row)
+# through segment_state/merge_kernel/pallas_kernel's removed_by_slot and
+# the summary lane lists — the same ~30-site pattern the rbits2 widening
+# followed (git: "Widen concurrent-writer cap to 62"). The cap is a
+# per-build constant rather than a runtime knob because lane count fixes
+# compiled kernel shapes; deployments needing more than 62 concurrent
+# writers per doc rebuild with more lanes, trading HBM per row.
 MAX_WRITERS = 62
 
 # Error flag bits in SegmentState.err.

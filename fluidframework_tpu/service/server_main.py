@@ -22,6 +22,14 @@ import sys
 import time
 from typing import Any, Dict
 
+# Honor JAX_PLATFORMS=cpu even where a sitecustomize pre-registers an
+# accelerator backend (env alone is not enough there) — deployments and
+# tests pin the backend explicitly; default is whatever the host offers.
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 DEFAULTS: Dict[str, Any] = {
     # The reference config.json keys this deployment consumes, renamed to
     # one flat namespace (layered lookup keeps the nconf override order).

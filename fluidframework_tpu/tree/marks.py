@@ -143,6 +143,8 @@ class _Reader:
     """Run reader with head splitting (the reference's MarkQueue)."""
 
     def __init__(self, marks: Changeset):
+        for t, _v in marks:
+            _check_kind(t)  # compose/rebase reject unknown kinds loudly
         self.q = [(t, v if t == "skip" else list(v)) for t, v in marks]
 
     def done(self) -> bool:

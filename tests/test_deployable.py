@@ -71,6 +71,10 @@ def test_server_main_process_starts_and_stops(tmp_path):
         + os.pathsep
         + env.get("PYTHONPATH", "")
     )
+    # Pin the subprocess to CPU: under full-suite load the tunneled
+    # accelerator backend's remote compiles are intermittent (the same
+    # failure mode the examples had); server_main honors this env.
+    env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.Popen(
         [sys.executable, "-m", "fluidframework_tpu.service.server_main",
          "--config", str(p)],

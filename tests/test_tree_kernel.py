@@ -170,11 +170,16 @@ def test_unknown_mark_kind_is_rejected_loudly():
     layer), never a silent miscompile."""
     with pytest.raises(ValueError, match="outside the sequence-field IR"):
         TK.from_marks([("mvout", [1, 2])], LC, PC)
-    # The host algebra rejects them too — never silently insert-coerced.
+    # The host algebra rejects them too — never silently insert-coerced,
+    # never hung (compose's reader used to spin on zero-length heads).
     with pytest.raises(ValueError, match="outside the sequence-field IR"):
         M.apply([1, 2], [("mvout", [1])])
     with pytest.raises(ValueError, match="outside the sequence-field IR"):
         M.invert([("revive", [1])])
+    with pytest.raises(ValueError, match="outside the sequence-field IR"):
+        M.compose([M.skip(1)], [("mvout", [9])])
+    with pytest.raises(ValueError, match="outside the sequence-field IR"):
+        M.rebase([("mvout", [5])], [M.skip(1)])
 
 
 def test_move_bearing_commit_falls_back_to_host_path():
