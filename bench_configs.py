@@ -415,10 +415,13 @@ def config5_deli_scribe_e2e(n_docs: int, ops_per_doc: int, on_tpu: bool) -> None
             n += 1
         return n
 
-    # Warmup compiles both kernels at the fleet shape via the service API.
+    # Warmup compiles both kernels at the fleet shape via the service API,
+    # plus the device-scribe gather at its bucket (steady-state scribe
+    # cadence keeps these warm in production).
     intents, rows = generate_round()
     err, stamped = svc.submit_round(intents, rows)
     assert not err.any(), "warmup tickets must stay on the fast path"
+    svc.summarize_dirty(threshold=1, max_docs=max(1, n_docs // rounds))
     assert int(svc.device_errors().sum()) == 0, (
         "warmup round must be clean — errs below count timed rounds only"
     )

@@ -73,13 +73,57 @@ class RestBlobBackend:
             return False
 
 
+def _ws_client_connect(host: str, port: int):
+    """Dial + websocket-upgrade one socket (shared by the op channel and
+    the push channel). Returns ``(sock, decoder, pending_frames)``. The
+    connect itself times out at 10s, then the socket goes blocking —
+    reader threads park in recv() indefinitely (an idle stream is normal;
+    a leftover timeout would silently kill the reader after 10 quiet
+    seconds)."""
+    sock = socket.create_connection((host, port), timeout=10)
+    try:
+        req, expect = wsproto.client_handshake(f"{host}:{port}", "/socket")
+        sock.sendall(req)
+        buf = b""
+        while True:
+            head = wsproto.read_http_head(buf)
+            if head is not None:
+                break
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("server closed during handshake")
+            buf += chunk
+        status, headers, rest = head
+        if b"101" not in status:
+            raise ConnectionError(f"websocket upgrade failed: {status!r}")
+        if headers.get("sec-websocket-accept") != expect:
+            raise ConnectionError("bad websocket accept key")
+        sock.settimeout(None)
+        decoder = wsproto.FrameDecoder()
+        return sock, decoder, decoder.feed(rest)
+    except BaseException:
+        try:
+            sock.close()
+        except OSError:
+            pass
+        raise
+
+
 class NetworkConnection:
     """Live delta stream over a websocket (DocumentDeltaConnection)."""
 
     def __init__(self, host: str, port: int, doc_id: str, tenant: str,
-                 token: str, mode: str, from_seq: int):
+                 token: str, mode: str, from_seq: int,
+                 push: bool = False):
         self.doc_id = doc_id
         self.inbox: List[SequencedDocumentMessage] = []
+        # Dual-channel ingest (odsp push-channel analog): sequenced ops may
+        # arrive on the op socket AND a delivery-only push socket; a seq
+        # watermark + stash keeps the inbox gap-free and duplicate-free
+        # regardless of which channel wins the race.
+        self._seq_watermark = from_seq
+        self._stash: dict = {}
+        self._push_sock: Optional[socket.socket] = None
         self.signals: List[SignalMessage] = []
         self.nacks: List[NackMessage] = []
         self.on_nack: Optional[Callable[[NackMessage], None]] = None
@@ -92,26 +136,10 @@ class NetworkConnection:
         self._connected = threading.Event()
         self._error: Optional[str] = None
 
-        self._sock = socket.create_connection((host, port), timeout=10)
+        self._sock, self._decoder, self._pending = _ws_client_connect(
+            host, port
+        )
         try:
-            req, expect = wsproto.client_handshake(f"{host}:{port}", "/socket")
-            self._sock.sendall(req)
-            buf = b""
-            while True:
-                head = wsproto.read_http_head(buf)
-                if head is not None:
-                    break
-                chunk = self._sock.recv(65536)
-                if not chunk:
-                    raise ConnectionError("server closed during handshake")
-                buf += chunk
-            status, headers, rest = head
-            if b"101" not in status:
-                raise ConnectionError(f"websocket upgrade failed: {status!r}")
-            if headers.get("sec-websocket-accept") != expect:
-                raise ConnectionError("bad websocket accept key")
-            self._decoder = wsproto.FrameDecoder()
-            self._pending = self._decoder.feed(rest)
             self._send_json(
                 {
                     "type": "connect_document",
@@ -131,12 +159,18 @@ class NetworkConnection:
             if self.client_id < 0:
                 # Socket dropped before connect_document_success arrived.
                 raise ConnectionError("connection closed before join completed")
+            if push:
+                self._open_push(
+                    host, port, tenant, token, self._seq_watermark
+                )
         except BaseException:
             self.closed = True
-            try:
-                self._sock.close()
-            except OSError:
-                pass
+            for s in (self._sock, self._push_sock):
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
             raise
 
     # -- wire ---------------------------------------------------------------
@@ -183,13 +217,17 @@ class NetworkConnection:
             self.conn_no = msg.get("conn_no", 0)
             if msg.get("initial_summary"):
                 self.initial_summary = tuple(msg["initial_summary"])
+                # Delivery starts above the summary head, not from_seq.
+                with self._lock:
+                    self._seq_watermark = max(
+                        self._seq_watermark, self.initial_summary[1]
+                    )
             self._connected.set()
         elif t == "connect_document_error":
             self._error = msg.get("error", "connect failed")
             self._connected.set()
         elif t == "op":
-            with self._lock:
-                self.inbox.append(from_jsonable(msg["msg"]))
+            self._ingest(from_jsonable(msg["msg"]))
         elif t == "signal":
             self.signals.append(
                 SignalMessage(
@@ -203,6 +241,66 @@ class NetworkConnection:
             self.nacks.append(nk)
             if self.on_nack:
                 self.on_nack(nk)
+
+    def _ingest(self, m: SequencedDocumentMessage) -> None:
+        """Watermark + stash merge: contiguous delivery into the inbox no
+        matter which channel (op socket / push socket) a seq arrives on
+        first; duplicates drop."""
+        with self._lock:
+            seq = m.sequence_number
+            if seq <= self._seq_watermark or seq in self._stash:
+                return
+            self._stash[seq] = m
+            while self._seq_watermark + 1 in self._stash:
+                self._seq_watermark += 1
+                self.inbox.append(self._stash.pop(self._seq_watermark))
+
+    # -- the push channel (odspDocumentDeltaConnection analog) ---------------
+
+    def _open_push(self, host: str, port: int, tenant: str, token: str,
+                   from_seq: int) -> None:
+        """Second, delivery-only socket: the server streams sequenced ops
+        from the durable log; ops race the main channel and merge through
+        the same watermark ingest."""
+        self._push_sock, self._push_decoder, pending = _ws_client_connect(
+            host, port
+        )
+        self._push_sock.sendall(
+            wsproto.encode_frame(
+                wsproto.OP_TEXT,
+                json.dumps(
+                    {
+                        "type": "subscribe_push",
+                        "doc": self.doc_id,
+                        "tenant": tenant,
+                        "token": token,
+                        "from_seq": from_seq,
+                    }
+                ).encode(),
+                mask=True,
+            )
+        )
+
+        def loop():
+            frames = pending
+            try:
+                while not self.closed:
+                    for opcode, payload in frames:
+                        if opcode == wsproto.OP_CLOSE:
+                            return
+                        if opcode == wsproto.OP_TEXT:
+                            msg = json.loads(payload.decode())
+                            if msg.get("type") == "op":
+                                self._ingest(from_jsonable(msg["msg"]))
+                    data = self._push_sock.recv(65536)
+                    if not data:
+                        return
+                    frames = self._push_decoder.feed(data)
+            except (OSError, ValueError):
+                pass  # push is best-effort; the op channel remains
+
+        self._push_reader = threading.Thread(target=loop, daemon=True)
+        self._push_reader.start()
 
     # -- LocalConnection surface -------------------------------------------
 
@@ -239,10 +337,15 @@ class NetworkConnection:
             except OSError:
                 pass
             self.closed = True
-            try:
-                self._sock.close()
-            except OSError:
-                pass
+        # Close both channels regardless of how we got here (a dead op
+        # socket sets self.closed in its read loop; the push fd must not
+        # leak behind it).
+        for s in (self._sock, self._push_sock):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
 
 
 class NetworkFluidService:
@@ -251,8 +354,13 @@ class NetworkFluidService:
     store)."""
 
     def __init__(self, host: str, port: int, tenant: str = "local",
-                 key: Optional[str] = None):
+                 key: Optional[str] = None, push: bool = False):
         self.host, self.port, self.tenant, self.key = host, port, tenant, key
+        # push=True opens a second delivery-only websocket per connection
+        # (the odsp push-channel analog): sequenced ops race both channels
+        # and merge through a watermark, so delivery survives one channel
+        # stalling (e.g. the op socket busy with a large submit).
+        self.push = push
         self._store: Optional[SummaryStore] = None
 
     def _auth(self, doc_id: str) -> str:
@@ -270,7 +378,8 @@ class NetworkFluidService:
             else ""
         )
         return NetworkConnection(
-            self.host, self.port, doc_id, self.tenant, token, mode, from_seq
+            self.host, self.port, doc_id, self.tenant, token, mode, from_seq,
+            push=self.push,
         )
 
     def get_channel_text(self, doc_id: str, channel_id: str) -> str:

@@ -78,6 +78,17 @@ class DeviceFleetBackend:
         # Per-channel ops applied since its last summary readback (the
         # dirtiness signal the device scribe keys on).
         self.ops_since_summary: Dict[ChannelKey, int] = {}
+        # Warm the first-flush kernel shapes NOW (throwaway fleets at the
+        # first few slot buckets x the minimum K bucket): the first
+        # compile otherwise lands inside a serving flush — synchronous in
+        # the in-proc pump — and a networked client's catch-up deadline
+        # can expire mid-compile (order-dependent test failures were
+        # traced to exactly this). The jit cache is process-wide, so this
+        # costs once per process, not per service.
+        for slots in (1, 2, 4):
+            warm = DocFleet(slots, capacity, max_capacity=max_capacity)
+            warm.apply(np.zeros((slots, 8, OP_WIDTH), np.int32))
+            warm.compact()
 
     # -- registry --------------------------------------------------------------
 

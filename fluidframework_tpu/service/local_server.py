@@ -280,6 +280,11 @@ class LocalFluidService:
 
     # -- delta storage (historical op fetch, driver storage.ts:81) -----------
 
+    def doc_head(self, doc_id: str) -> int:
+        """Latest durable sequence number (cheap push-delivery probe)."""
+        log = self._doc(doc_id).op_log
+        return log[-1].sequence_number if log else 0
+
     def get_deltas(
         self, doc_id: str, from_seq: int = 0, to_seq: Optional[int] = None
     ) -> List[SequencedDocumentMessage]:

@@ -64,12 +64,20 @@ class TenantManager:
 
 
 class _Session:
-    """One websocket client: its service connection + outbound writer."""
+    """One websocket client: its service connection + outbound writer.
+
+    A session is either an op channel (``conn`` set after
+    connect_document) or a PUSH subscriber (``push_doc`` set after
+    subscribe_push) — the odsp push-channel analog
+    (odspDocumentDeltaConnection.ts): delivery-only, no quorum join, ops
+    streamed from the durable log by watermark."""
 
     def __init__(self, writer: asyncio.StreamWriter):
         self.writer = writer
         self.conn = None  # service connection once connect_document succeeds
         self.doc_id: Optional[str] = None
+        self.push_doc: Optional[str] = None
+        self.push_seq = 0  # delivery watermark for push subscribers
 
 
 class FluidNetworkServer:
@@ -359,7 +367,7 @@ class FluidNetworkServer:
     def _on_message(self, session: _Session, msg: dict) -> None:
         t = msg.get("type")
         if t == "connect_document":
-            if session.conn is not None:
+            if session.conn is not None or session.push_doc is not None:
                 # One document connection per socket: releasing the old one
                 # implicitly here would leak quorum entries on client bugs.
                 self._send(session, {"type": "connect_document_error",
@@ -392,6 +400,21 @@ class FluidNetworkServer:
                     else None,
                 },
             )
+        elif t == "subscribe_push":
+            if session.conn is not None:
+                # One role per socket: a combined session would starve its
+                # op-channel queue in _drain_all.
+                self._send(session, {"type": "subscribe_push_error",
+                                     "error": "socket already an op channel"})
+                return
+            doc_id = msg["doc"]
+            if not self._authorized(msg, doc_id):
+                self._send(session, {"type": "subscribe_push_error",
+                                     "error": "invalid token"})
+                return
+            session.push_doc = doc_id
+            session.push_seq = int(msg.get("from_seq", 0))
+            self._send(session, {"type": "subscribe_push_success"})
         elif t == "submitOp" and session.conn is not None:
             session.conn.submit(from_jsonable(msg["op"]))
         elif t == "submitSignal" and session.conn is not None:
@@ -403,6 +426,19 @@ class FluidNetworkServer:
         """Forward anything the service put in per-connection queues since
         the last drain (the broadcaster role at the socket layer)."""
         for s in self._sessions:
+            if s.push_doc is not None:
+                # Push delivery: stream newly sequenced ops straight from
+                # the durable log past the subscriber's watermark. A cheap
+                # head probe skips the log scan on idle ticks.
+                head = getattr(self.service, "doc_head", None)
+                if head is not None and head(s.push_doc) <= s.push_seq:
+                    continue
+                for m in self.service.get_deltas(
+                    s.push_doc, from_seq=s.push_seq
+                ):
+                    self._send(s, {"type": "op", "msg": to_jsonable(m)})
+                    s.push_seq = max(s.push_seq, m.sequence_number)
+                continue
             if s.conn is None:
                 continue
             for m in s.conn.take_inbox():

@@ -357,6 +357,12 @@ class PipelineFluidService:
         )
         self.pump()
 
+    def doc_head(self, doc_id: str) -> int:
+        """Latest durable sequence number — a cheap probe (no pump) for
+        push-delivery idle ticks."""
+        ops = self.ops_store.get(doc_id)
+        return max(ops) if ops else 0
+
     def get_deltas(
         self, doc_id: str, from_seq: int = 0, to_seq: Optional[int] = None
     ) -> List[SequencedDocumentMessage]:

@@ -174,6 +174,99 @@ def test_pipeline_service_behind_sockets():
         srv.stop()
 
 
+def test_push_channel_delivers_and_dedupes(server):
+    """Odsp push-channel analog: clients with push=True receive sequenced
+    ops over BOTH the op socket and a delivery-only push socket; the
+    watermark merge keeps the container's stream gap-free and
+    duplicate-free, and collaboration converges as usual."""
+    svc_a = NetworkFluidService("127.0.0.1", server.port, push=True)
+    svc_b = NetworkFluidService("127.0.0.1", server.port, push=True)
+    a = ContainerRuntime(svc_a, "pushdoc", channels=(SharedString("t"),))
+    b = ContainerRuntime(svc_b, "pushdoc", channels=(SharedString("t"),))
+    a.get_channel("t").insert_text(0, "push")
+    drain_networked([a, b])
+    b.get_channel("t").insert_text(4, " channel")
+    drain_networked([a, b])
+    assert (
+        a.get_channel("t").get_text()
+        == b.get_channel("t").get_text()
+        == "push channel"
+    )
+    # The push subscription is genuinely live on the server.
+    assert any(
+        s.push_doc == "pushdoc" for s in server._sessions
+    ), "no push subscriber registered"
+    a.disconnect()
+    b.disconnect()
+
+
+def test_push_only_subscriber_streams_the_log(server):
+    """A delivery-only subscriber (no document join, no quorum entry)
+    receives every sequenced op past its watermark — the push service's
+    contract."""
+    import json as _json
+    import socket as _socket
+
+    from fluidframework_tpu.service import wsproto
+
+    svc_a = NetworkFluidService("127.0.0.1", server.port)
+    a = ContainerRuntime(svc_a, "streamdoc", channels=(SharedString("t"),))
+    a.get_channel("t").insert_text(0, "seed")
+    drain_networked([a])
+
+    sock = _socket.create_connection(("127.0.0.1", server.port), timeout=10)
+    req, _exp = wsproto.client_handshake(
+        f"127.0.0.1:{server.port}", "/socket"
+    )
+    sock.sendall(req)
+    buf = b""
+    while wsproto.read_http_head(buf) is None:
+        buf += sock.recv(65536)
+    _status, _headers, rest = wsproto.read_http_head(buf)
+    dec = wsproto.FrameDecoder()
+    frames = list(dec.feed(rest))
+    sock.sendall(
+        wsproto.encode_frame(
+            wsproto.OP_TEXT,
+            _json.dumps(
+                {"type": "subscribe_push", "doc": "streamdoc", "from_seq": 0}
+            ).encode(),
+            mask=True,
+        )
+    )
+    a.get_channel("t").insert_text(4, "!")
+    a.flush()
+    got_ops = []
+    import time as _time
+
+    deadline = _time.monotonic() + 15
+    sock.settimeout(0.5)
+    while _time.monotonic() < deadline:
+        try:
+            data = sock.recv(65536)
+        except TimeoutError:
+            # Push delivery rides the server's drain tick, which inbound
+            # frames trigger: ping to tickle it (and keep pumping).
+            sock.sendall(
+                wsproto.encode_frame(wsproto.OP_PING, b"", mask=True)
+            )
+            a.process_incoming()
+            continue
+        if not data:
+            break
+        for opcode, payload in dec.feed(data):
+            if opcode == wsproto.OP_TEXT:
+                m = _json.loads(payload.decode())
+                if m.get("type") == "op":
+                    got_ops.append(m["msg"]["sequence_number"])
+        if len(got_ops) >= 3:
+            break
+    sock.close()
+    # Every sequenced op of the doc so far, in order, no join consumed.
+    assert got_ops == sorted(got_ops) and len(got_ops) >= 3, got_ops
+    a.disconnect()
+
+
 def test_url_factory_roundtrip(server):
     factory = NetworkDocumentServiceFactory()
     ds = factory.create_document_service(
