@@ -160,9 +160,14 @@ class NetworkConnection:
                 # Socket dropped before connect_document_success arrived.
                 raise ConnectionError("connection closed before join completed")
             if push:
-                self._open_push(
-                    host, port, tenant, token, self._seq_watermark
-                )
+                try:
+                    self._open_push(
+                        host, port, tenant, token, self._seq_watermark
+                    )
+                except (OSError, ConnectionError):
+                    # Push is best-effort: a failed second dial must not
+                    # kill the established op channel.
+                    self._push_sock = None
         except BaseException:
             self.closed = True
             for s in (self._sock, self._push_sock):

@@ -40,6 +40,8 @@ from fluidframework_tpu.utils import pow2_at_least as _pow2_at_least
 
 ChannelKey = Tuple[str, str]  # (doc_id, channel address)
 
+_WARMED: set = set()  # (capacity, max_capacity) warmups done this process
+
 
 class DeviceFleetBackend:
     """The service's device compute backend: one DocFleet slot per string
@@ -83,12 +85,16 @@ class DeviceFleetBackend:
         # compile otherwise lands inside a serving flush — synchronous in
         # the in-proc pump — and a networked client's catch-up deadline
         # can expire mid-compile (order-dependent test failures were
-        # traced to exactly this). The jit cache is process-wide, so this
-        # costs once per process, not per service.
-        for slots in (1, 2, 4):
-            warm = DocFleet(slots, capacity, max_capacity=max_capacity)
-            warm.apply(np.zeros((slots, 8, OP_WIDTH), np.int32))
-            warm.compact()
+        # traced to exactly this). Once per process per capacity — the
+        # jit cache is global, so later backends skip even the throwaway
+        # dispatches.
+        key = (capacity, max_capacity)
+        if key not in _WARMED:
+            _WARMED.add(key)
+            for slots in (1, 2, 4):
+                warm = DocFleet(slots, capacity, max_capacity=max_capacity)
+                warm.apply(np.zeros((slots, 8, OP_WIDTH), np.int32))
+                warm.compact()
 
     # -- registry --------------------------------------------------------------
 

@@ -285,6 +285,19 @@ class LocalFluidService:
         log = self._doc(doc_id).op_log
         return log[-1].sequence_number if log else 0
 
+    def ops_range(
+        self, doc_id: str, from_seq: int, to_seq: int
+    ) -> List[SequencedDocumentMessage]:
+        """Ops in [from_seq, to_seq] by index offset — O(k) push delivery
+        (the log is seq-ordered and contiguous from its first entry)."""
+        log = self._doc(doc_id).op_log
+        if not log:
+            return []
+        first = log[0].sequence_number
+        lo = max(0, from_seq - first)
+        hi = max(0, to_seq - first + 1)
+        return list(log[lo:hi])
+
     def get_deltas(
         self, doc_id: str, from_seq: int = 0, to_seq: Optional[int] = None
     ) -> List[SequencedDocumentMessage]:

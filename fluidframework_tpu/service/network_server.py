@@ -401,11 +401,12 @@ class FluidNetworkServer:
                 },
             )
         elif t == "subscribe_push":
-            if session.conn is not None:
-                # One role per socket: a combined session would starve its
-                # op-channel queue in _drain_all.
+            if session.conn is not None or session.push_doc is not None:
+                # One role per socket, once: a combined session would
+                # starve its op-channel queue in _drain_all, and a repeat
+                # subscribe would rewind the watermark (redelivery flood).
                 self._send(session, {"type": "subscribe_push_error",
-                                     "error": "socket already an op channel"})
+                                     "error": "socket already bound"})
                 return
             doc_id = msg["doc"]
             if not self._authorized(msg, doc_id):
@@ -429,13 +430,21 @@ class FluidNetworkServer:
             if s.push_doc is not None:
                 # Push delivery: stream newly sequenced ops straight from
                 # the durable log past the subscriber's watermark. A cheap
-                # head probe skips the log scan on idle ticks.
-                head = getattr(self.service, "doc_head", None)
-                if head is not None and head(s.push_doc) <= s.push_seq:
+                # head probe skips idle ticks; ranged lookup (where the
+                # service offers it) keeps per-tick cost O(new ops), not
+                # O(log).
+                head_fn = getattr(self.service, "doc_head", None)
+                head = head_fn(s.push_doc) if head_fn else None
+                if head is not None and head <= s.push_seq:
                     continue
-                for m in self.service.get_deltas(
-                    s.push_doc, from_seq=s.push_seq
-                ):
+                ranged = getattr(self.service, "ops_range", None)
+                if ranged is not None and head is not None:
+                    msgs = ranged(s.push_doc, s.push_seq + 1, head)
+                else:
+                    msgs = self.service.get_deltas(
+                        s.push_doc, from_seq=s.push_seq
+                    )
+                for m in msgs:
                     self._send(s, {"type": "op", "msg": to_jsonable(m)})
                     s.push_seq = max(s.push_seq, m.sequence_number)
                 continue

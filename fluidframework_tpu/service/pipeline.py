@@ -363,6 +363,17 @@ class PipelineFluidService:
         ops = self.ops_store.get(doc_id)
         return max(ops) if ops else 0
 
+    def ops_range(
+        self, doc_id: str, from_seq: int, to_seq: int
+    ) -> List[SequencedDocumentMessage]:
+        """Ops in [from_seq, to_seq] by direct seq lookup — O(k) for push
+        delivery, vs get_deltas's full-log sort."""
+        self.pump()
+        ops = self.ops_store.get(doc_id, {})
+        return [
+            ops[s] for s in range(from_seq, to_seq + 1) if s in ops
+        ]
+
     def get_deltas(
         self, doc_id: str, from_seq: int = 0, to_seq: Optional[int] = None
     ) -> List[SequencedDocumentMessage]:

@@ -1,0 +1,200 @@
+"""Lineage-aware EditManager trunk scan — concurrent commits on device.
+
+``tree/device_trunk.py`` runs the POSITIONAL-rebase algebra (marks.py) on
+device, which provably diverges from the production EditManager's
+id-anchor/lineage semantics on concurrent ties (see
+``test_tree_device_path.py::test_algebra_divergence_documented``), so the
+round-3 fast path was gated to concurrency-free prefixes. THIS kernel
+lifts that gate by computing the EditManager's own algebra
+(``tree/edit_manager.py`` ``_transport`` + ``apply_ops_to_view``, the
+reference's lineage semantics, ``sequence-field/format.ts`` lineage marks)
+as dense device work:
+
+- the scan carries a ring of the last ``W`` TRUNK ID-STATES (not
+  changesets) keyed by seq, so a commit's author view at ``ref`` is one
+  ring select — exact, because device-eligible commits are authored with
+  no pending chain (their view IS trunk-at-ref);
+- the commit's positional marks decode against that view on device:
+  deleted ids become a multihot over the interned id universe ``U`` and
+  membership tests are one-hot matmuls (MXU work, no serialized gathers);
+- each insert run resolves its anchor exactly as ``_transport`` does —
+  nearest LEFT neighbor in the author's post-edit view that is present in
+  the evolving output — via a prefix cumulative max over a membership
+  mask, then inserts with the standard prefix-sum scatter.
+
+Per-commit work is O(runs * Lc * U) matmul FLOPs with no data-dependent
+control flow; ``vmap`` batches documents. Commits whose ``ref`` fell off
+the ring (or is not a retained seq) flag the sticky err lane and the
+caller replays on the host path — same contract as the positional scan.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from fluidframework_tpu.ops.tree_kernel import (
+    DenseChange,
+    _onehot_f32,
+    _scatter_add,
+    apply_change,
+)
+
+_HIGHEST = jax.lax.Precision.HIGHEST
+
+
+class EmCommitBatch(NamedTuple):
+    """C sequenced commits for one document, lowered for the EM scan.
+
+    Marks are positional over the AUTHOR VIEW at ``ref`` (= trunk-at-ref
+    for device-eligible commits). ``run_*`` describe the commit's insert
+    runs in wire order: start position in the POST view, length, offset of
+    the run's first id in the ``ins_ids`` pool (-1 start = unused slot).
+    """
+
+    del_mask: jnp.ndarray  # int32[C, Lc]
+    ins_cnt: jnp.ndarray  # int32[C, Lc+1]
+    ins_ids: jnp.ndarray  # int32[C, Pc] (interned ids, pool order)
+    run_start: jnp.ndarray  # int32[C, R]
+    run_len: jnp.ndarray  # int32[C, R]
+    run_off: jnp.ndarray  # int32[C, R]
+    ref: jnp.ndarray  # int32[C]
+    seq: jnp.ndarray  # int32[C]
+
+
+def _member(ids: jnp.ndarray, multihot: jnp.ndarray) -> jnp.ndarray:
+    """membership[i] = multihot[ids[i]] as a one-hot matmul (gathers
+    serialize on TPU)."""
+    oh = _onehot_f32(ids, multihot.shape[-1])
+    return jax.lax.dot_general(
+        oh, multihot.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        precision=_HIGHEST,
+    ).astype(jnp.int32)
+
+
+def _multihot(ids: jnp.ndarray, mask: jnp.ndarray, U: int) -> jnp.ndarray:
+    """multihot[u] = 1 iff some masked ids[i] == u (id 0 = padding never
+    set: masked positions drive to 0 and slot 0 is cleared)."""
+    vec = _scatter_add(jnp.where(mask, ids, 0), mask.astype(jnp.int32), U)
+    return (vec.at[0].set(0) > 0).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnums=(4, 5))
+def batched_em_trunk_scan(doc_ids, L, base_seq, commits: EmCommitBatch,
+                          W: int, U: int):
+    """[N, ...] documents, each with its own commit stream. ``base_seq``
+    [N] is the trunk seq of the initial state (commits may ref it)."""
+    return jax.vmap(
+        lambda d, l, b, cb: em_trunk_scan_one(d, l, b, cb, W, U)
+    )(doc_ids, L, base_seq, commits)
+
+
+def em_trunk_scan_one(doc_ids, L, base_seq, commits: EmCommitBatch,
+                      W: int, U: int):
+    """Single-document EM trunk scan (see module docstring)."""
+    Lc = doc_ids.shape[-1]
+    Pc = commits.ins_ids.shape[-1]
+    R = commits.run_start.shape[-1]
+
+    # The base state sits at the NEWEST slot: each push rolls left and
+    # writes slot W-1, so empties (seq -1) evict first and the base
+    # survives W-1 pushes.
+    ring_ids = jnp.zeros((W, Lc), jnp.int32).at[W - 1].set(doc_ids)
+    ring_L = jnp.zeros(W, jnp.int32).at[W - 1].set(L)
+    ring_seq = jnp.full(W, -1, jnp.int32).at[W - 1].set(base_seq)
+
+    def step(carry, inp):
+        doc_ids, L, ring_ids, ring_L, ring_seq, err = carry
+        ref = inp["ref"]
+        seq = inp["seq"]
+        c = DenseChange(inp["del"], inp["ins"], inp["ids"])
+
+        # 1. Author view at ref: the LATEST ring state with seq <= ref
+        #    (document seqs are sparse — joins and other channels consume
+        #    numbers — so trunk-at-ref is the newest trunk state at or
+        #    below it). Err when every retained state is newer (evicted).
+        mask = (ring_seq >= 0) & (ring_seq <= ref)
+        best = jnp.max(jnp.where(mask, ring_seq, -1))
+        err = err | (best < 0).astype(jnp.int32)
+        hit = ((ring_seq == best) & mask).astype(jnp.int32)
+        av_ids = jnp.sum(ring_ids * hit[:, None], axis=0)
+        av_L = jnp.sum(ring_L * hit)
+
+        # 2. Post view: the commit applied to the author view.
+        post_ids, _post_L = apply_change(av_ids, av_L, c)
+
+        # 3. Deleted ids -> multihot over U; drop them from the current
+        #    trunk (deletes are idempotent: absent ids match nothing).
+        av_valid = jnp.arange(Lc) < av_L
+        del_vec = _multihot(av_ids, (c.del_mask > 0) & av_valid, U)
+        cur_valid = jnp.arange(Lc) < L
+        cur_del = _member(doc_ids, del_vec) * cur_valid
+        doc2, L2 = apply_change(
+            doc_ids, L,
+            DenseChange(cur_del, jnp.zeros(Lc + 1, jnp.int32),
+                        jnp.zeros(Pc, jnp.int32)),
+        )
+
+        # 4. Insert runs in wire order, each anchored after the nearest
+        #    left post-view neighbor present in the evolving output.
+        def run_body(r, state):
+            doc2, L2 = state
+            start = inp["run_start"][r]
+            length = inp["run_len"][r]
+            off = inp["run_off"][r]
+            active = start >= 0
+            present = _multihot(doc2, jnp.arange(Lc) < L2, U)
+            pres = _member(post_ids, present)  # [Lc] membership of post
+            # Nearest left neighbor: cumulative max of (j if pres else -1)
+            # evaluated at start-1.
+            cand = jnp.where(pres > 0, jnp.arange(Lc), -1)
+            cmax = jax.lax.associative_scan(jnp.maximum, cand)
+            best = jnp.where(start > 0, cmax[jnp.maximum(start - 1, 0)], -1)
+            anchor_id = post_ids[jnp.maximum(best, 0)]
+            # Position of the anchor in doc2 (single match by id).
+            match = (doc2 == anchor_id) & (jnp.arange(Lc) < L2)
+            a_pos = jnp.sum(jnp.where(match, jnp.arange(Lc) + 1, 0))
+            p = jnp.where(best >= 0, a_pos, 0)  # insert AFTER anchor
+            # Run pool slice in boundary order: roll the pool so the run's
+            # ids lead, mask to its length.
+            pool = jnp.roll(inp["ids"], -off)
+            pool = jnp.where(jnp.arange(Pc) < length, pool, 0)
+            ins_cnt = _scatter_add(
+                jnp.where(active, p, -1)[None],
+                jnp.asarray([1], jnp.int32) * length, Lc + 1,
+            )
+            new_doc, new_L = apply_change(
+                doc2, L2,
+                DenseChange(jnp.zeros(Lc, jnp.int32), ins_cnt, pool),
+            )
+            keep = active & (length > 0)
+            return (
+                jnp.where(keep, new_doc, doc2),
+                jnp.where(keep, new_L, L2),
+            )
+
+        doc_new, L_new = jax.lax.fori_loop(0, R, run_body, (doc2, L2))
+        err = err | (L_new > Lc).astype(jnp.int32)
+
+        # 5. Push the new trunk state into the ring (evict oldest).
+        ring_ids = jnp.roll(ring_ids, -1, axis=0).at[W - 1].set(doc_new)
+        ring_L = jnp.roll(ring_L, -1).at[W - 1].set(L_new)
+        ring_seq = jnp.roll(ring_seq, -1).at[W - 1].set(seq)
+        return (doc_new, L_new, ring_ids, ring_L, ring_seq, err), None
+
+    init = (doc_ids, L, ring_ids, ring_L, ring_seq, jnp.int32(0))
+    xs = {
+        "del": commits.del_mask,
+        "ins": commits.ins_cnt,
+        "ids": commits.ins_ids,
+        "run_start": commits.run_start,
+        "run_len": commits.run_len,
+        "run_off": commits.run_off,
+        "ref": commits.ref,
+        "seq": commits.seq,
+    }
+    (doc_ids, L, _ri, _rl, _rs, err), _ = jax.lax.scan(step, init, xs)
+    return doc_ids, L, err

@@ -105,12 +105,14 @@ def apply_ops_to_view(
 
 class EditManager:
     # Device fast-path knobs (see add_sequenced_batch): ring depth of the
-    # trunk-scan kernel, the largest dense capacity we'll compile for, and
-    # the smallest batch worth a device dispatch (interning + lowering +
-    # kernel launch cost ~ms; tiny interactive drains stay on the host).
+    # trunk-scan kernel, the largest dense capacity we'll compile for, the
+    # smallest batch worth a device dispatch (interning + lowering +
+    # kernel launch cost ~ms; tiny interactive drains stay on the host),
+    # and the max insert runs per commit the EM kernel unrolls.
     DEVICE_WINDOW = 16
     DEVICE_MAX_LC = 4096
     DEVICE_MIN_BATCH = 4
+    DEVICE_MAX_RUNS = 16
 
     def __init__(self, session: int):
         self.session = session
@@ -182,13 +184,21 @@ class EditManager:
 
     def add_sequenced_batch(self, commits: List[Commit], min_seq: int) -> None:
         """Ingest a run of sequenced commits, routing the maximal eligible
-        prefix through the device trunk-scan kernel
-        (:func:`~fluidframework_tpu.tree.device_trunk.batched_trunk_scan`)
-        and the remainder through the per-commit host path. Semantically
-        identical to ``add_sequenced`` per commit + ``advance_min_seq``.
+        prefix through the LINEAGE-AWARE device scan
+        (:func:`~fluidframework_tpu.tree.device_em.batched_em_trunk_scan`
+        — this EditManager's own id-anchor algebra as dense kernels, so
+        CONCURRENT spans ride the device too) and the remainder through
+        the per-commit host path. Semantically identical to
+        ``add_sequenced`` per commit + ``advance_min_seq``. (The
+        positional-rebase kernel in ``tree/device_trunk.py`` remains the
+        marks-algebra engine for config 3b; its tie semantics provably
+        diverge from this class on concurrent gap collapses —
+        ``test_tree_device_path.py::test_algebra_divergence_documented``
+        — which is exactly why THIS path computes the EM algebra
+        natively instead.)
 
         Eligibility (sound, checked host-side; the kernel's err lane
-        additionally guards the ring window at runtime with transparent
+        additionally guards the state ring at runtime with transparent
         fallback):
 
         - ``inflight == 0`` and no own-session commits — the device scan
@@ -199,20 +209,12 @@ class EditManager:
           per-commit trunk forms, so nothing may ever rebase into its
           range (reference editManager.ts:142-281 keeps the trunk window
           for exactly those rebases);
-        - every prefix commit is CAUGHT UP: ``ref >=`` the previous
-          prefix commit's seq (and >= ``trunk_seq`` at entry). Concurrent
-          spans fall back to the host path BY CONTRACT: this EditManager
-          merges with id-anchor/lineage semantics (nearest SURVIVING left
-          neighbor, own-run anchoring — the reference sequence-field
-          lineage), while the dense kernel rebases positionally
-          (boundary-order ties, ``tree/marks.py``). The two algebras
-          agree exactly on concurrency-free runs and are PROVEN to
-          diverge on concurrent gap-collapse ties —
-          ``test_tree_device_path.py::test_algebra_divergence_documented``
-          pins a witness, which is why the gate exists. Unifying the
-          kernel with lineage semantics is the follow-up that would lift
-          the gate;
-        - dense capacities fit (document + inserts within DEVICE_MAX_LC).
+        - every prefix commit is caught up on ITS OWN session (``ref >=``
+          the session's previous commit — its author view is then exactly
+          trunk-at-ref, the kernel's ring entry) and refs a seq the
+          W-deep state ring still retains;
+        - marks within the {skip, del, ins} vocabulary, run count within
+          DEVICE_MAX_RUNS, dense capacities within DEVICE_MAX_LC.
         """
         if not commits:
             self.advance_min_seq(min_seq)
@@ -253,12 +255,18 @@ class EditManager:
             return 0
         total_ins = len(self.trunk_state)
         prefix = 0
-        prev_seq = base
+        last_of: Dict[int, int] = {}
+        # Seqs the kernel's W-deep state ring will retain at each step.
+        retained = [base]
         for c in commits:
             if c.seq > b or c.session == self.session:
                 break
-            if c.ref < prev_seq:  # concurrent: host path (see docstring)
+            if c.ref < last_of.get(c.session, 0):
+                # Author had a pending chain when authoring: its view is
+                # NOT trunk-at-ref; host path reconstructs the mirror.
                 break
+            if c.ref < retained[0]:
+                break  # ring would have evicted the ref state
             if any(t not in M.MARK_KINDS for t, _v in c.change):
                 # Mark kinds beyond the dense IR (the reference sequence-
                 # field also has MoveOut/MoveIn/Revive, format.ts:14-220;
@@ -267,10 +275,16 @@ class EditManager:
                 # the host path BY CONTRACT — never silently miscompiled.
                 break
             n_ins = sum(len(v) for t, v in c.change if t == "ins")
+            n_runs = sum(1 for t, _v in c.change if t == "ins")
             total_ins += n_ins
             if total_ins + 8 > self.DEVICE_MAX_LC:
                 break
-            prev_seq = c.seq
+            if n_runs > self.DEVICE_MAX_RUNS:
+                break
+            last_of[c.session] = c.seq
+            retained.append(c.seq)
+            if len(retained) > self.DEVICE_WINDOW:
+                retained.pop(0)
             prefix += 1
         # The fast path records no per-commit trunk forms, so NO remainder
         # commit may rebase into the prefix range either: shrink until
@@ -281,15 +295,17 @@ class EditManager:
         return prefix if prefix >= self.DEVICE_MIN_BATCH else 0
 
     def _device_ingest(self, commits: List[Commit]) -> bool:
-        """Run the prefix through the trunk-scan kernel. Returns False —
-        with state untouched — when the kernel's ring-window guard trips
-        (the caller then replays the same commits on the host path)."""
+        """Run the prefix through the lineage-aware EM scan
+        (``tree/device_em.py`` — this class's own algebra as dense
+        kernels). Returns False — with state untouched — when the
+        kernel's err lane trips (ring miss / capacity), and the caller
+        replays the same commits on the host path."""
         import numpy as np
 
         from fluidframework_tpu.ops import tree_kernel as TK
-        from fluidframework_tpu.tree.device_trunk import (
-            CommitBatch,
-            batched_trunk_scan,
+        from fluidframework_tpu.tree.device_em import (
+            EmCommitBatch,
+            batched_em_trunk_scan,
         )
 
         # Intern cells as dense int32 ids; values stay host-side.
@@ -313,42 +329,60 @@ class EditManager:
         lc = _pow2(max(total + 8, 32))
         pc = _pow2(max_ins)
         C = _pow2(len(commits))
+        R = self.DEVICE_MAX_RUNS
         dm = np.zeros((C, lc), np.int32)
         ic = np.zeros((C, lc + 1), np.int32)
         ii = np.zeros((C, pc), np.int32)
+        r_start = np.full((C, R), -1, np.int32)
+        r_len = np.zeros((C, R), np.int32)
+        r_off = np.zeros((C, R), np.int32)
         refs = np.zeros(C, np.int32)
         seqs = np.zeros(C, np.int32)
         for k, c in enumerate(commits):
-            i = 0
+            i_in = 0  # position in the author view (input coords)
+            i_out = 0  # position in the post view (run starts live here)
             p = 0
+            r = 0
             for t, v in c.change:
                 if t == "skip":
-                    i += v
+                    i_in += v
+                    i_out += v
                 elif t == "del":
-                    dm[k, i : i + len(v)] = 1
-                    i += len(v)
+                    dm[k, i_in : i_in + len(v)] = 1
+                    i_in += len(v)
                 else:
-                    ic[k, i] += len(v)
+                    ic[k, i_in] += len(v)
+                    r_start[k, r] = i_out
+                    r_len[k, r] = len(v)
+                    r_off[k, r] = p
+                    r += 1
                     for cell in v:
                         ii[k, p] = intern(cell)
                         p += 1
+                    i_out += len(v)
             refs[k] = c.ref
             seqs[k] = c.seq
-        # Identity padding: empty changes advancing seq keep shapes pow2
+        # Identity padding: empty commits advancing seq keep shapes pow2
         # (k >= len(commits) >= DEVICE_MIN_BATCH, so seqs[k-1] is set).
         for k in range(len(commits), C):
             refs[k] = seqs[k - 1]
             seqs[k] = seqs[k - 1] + 1
+        U = _pow2(len(cell_of) + 2)
         ids0 = np.zeros((1, lc), np.int32)
         ids0[0, : len(doc)] = doc
-        out_ids, out_L, err = batched_trunk_scan(
+        out_ids, out_L, err = batched_em_trunk_scan(
             ids0,
             np.asarray([len(doc)], np.int32),
-            CommitBatch(dm[None], ic[None], ii[None], refs[None], seqs[None]),
+            np.asarray([self.trunk_seq], np.int32),
+            EmCommitBatch(
+                dm[None], ic[None], ii[None], r_start[None], r_len[None],
+                r_off[None], refs[None], seqs[None],
+            ),
             self.DEVICE_WINDOW,
+            U,
         )
         if int(np.asarray(err)[0]):
-            return False  # ring window exceeded: host path replays
+            return False  # ring miss / capacity: host path replays
         final = TK.dense_to_doc(out_ids[0], out_L[0])
         self.trunk_state = [cell_of[i - 1] for i in final]
         self.trunk_seq = commits[-1].seq

@@ -264,6 +264,12 @@ def test_push_only_subscriber_streams_the_log(server):
     sock.close()
     # Every sequenced op of the doc so far, in order, no join consumed.
     assert got_ops == sorted(got_ops) and len(got_ops) >= 3, got_ops
+    # Drain a's own ack before disconnecting (its delivery races the push
+    # socket's — disconnect asserts nothing is pending).
+    deadline = _time.monotonic() + 10
+    while a.pending and _time.monotonic() < deadline:
+        a.process_incoming()
+        _time.sleep(0.01)
     a.disconnect()
 
 
