@@ -58,7 +58,7 @@ def _visible_len(h, i: int, *, ref_seq: Optional[int], client: int) -> int:
         return 0
     rseq = int(h.rseq[i])
     by_client = client >= 0 and removed_by_slot_host(
-        int(h.rbits[i]), int(h.rbits2[i]), client
+        int(h.rbits[i]), int(h.rbits2[i]), int(h.rbits3[i]), client
     )
     removed = by_client or (
         rseq not in (RSEQ_NONE, UNASSIGNED_SEQ) and rseq <= ref_seq

@@ -234,7 +234,7 @@ class SharedMatrix(SharedObject):
                     k: np.asarray(getattr(h, k))[:n].tolist()
                     for k in (
                         "kind", "orig", "off", "length", "seq", "client",
-                        "lseq", "rseq", "rlseq", "rbits", "rbits2", "aseq", "alseq",
+                        "lseq", "rseq", "rlseq", "rbits", "rbits2", "rbits3", "aseq", "alseq",
                         "aval",
                     )
                 },

@@ -465,7 +465,8 @@ class SharedString(SharedObject):
         return {
             "lanes": {k: np.asarray(getattr(h, k))[:n].tolist() for k in (
                 "kind", "orig", "off", "length", "seq", "client", "lseq",
-                "rseq", "rlseq", "rbits", "rbits2", "aseq", "alseq", "aval",
+                "rseq", "rlseq", "rbits", "rbits2", "rbits3", "aseq",
+                "alseq", "aval",
             )},
             "count": n,
             "min_seq": int(h.min_seq),

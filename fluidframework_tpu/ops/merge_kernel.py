@@ -116,7 +116,9 @@ def perspective(state: SegmentState, ref_seq, client, is_local):
     # a pending local remove never hides a row from a remote op's view,
     # and a pending local insert is invisible unless client-matched.
     rseq_eff = jnp.where(state.rseq == UNASSIGNED_SEQ, RSEQ_NONE, state.rseq)
-    removed_by_client = removed_by_slot(state.rbits, state.rbits2, client)
+    removed_by_client = removed_by_slot(
+        state.rbits, state.rbits2, state.rbits3, client
+    )
     hidden = removed & ((rseq_eff <= ref_seq) | removed_by_client)
     seq_eff = jnp.where(
         state.seq == UNASSIGNED_SEQ, NORM_EXISTING_LOCAL, state.seq
@@ -231,6 +233,7 @@ def _apply_insert(state: SegmentState, op: jnp.ndarray) -> SegmentState:
         rlseq=z,
         rbits=z,
         rbits2=z,
+        rbits3=z,
         aseq=z,
         alseq=z,
         aval=z,
@@ -310,7 +313,7 @@ def _apply_remove(state: SegmentState, op: jnp.ndarray) -> SegmentState:
     )
 
     local_op = op[F_SEQ] == UNASSIGNED_SEQ
-    bit_lo, bit_hi = writer_bits(op[F_CLIENT])
+    bit_lo, bit_mid, bit_hi = writer_bits(op[F_CLIENT])
     not_removed = state.rseq == RSEQ_NONE
     was_local = state.rseq == UNASSIGNED_SEQ
 
@@ -322,7 +325,8 @@ def _apply_remove(state: SegmentState, op: jnp.ndarray) -> SegmentState:
         rseq=new_rseq,
         rlseq=new_rlseq,
         rbits=state.rbits | bit_lo,
-        rbits2=state.rbits2 | bit_hi,
+        rbits2=state.rbits2 | bit_mid,
+        rbits3=state.rbits3 | bit_hi,
     )
     return _bookkeep(state, op)
 

@@ -123,7 +123,7 @@ def _apply_values(ops_ref, tables_ref, scalars_ref):
     def step(k, carry):
         lanes, count, min_seq, cur_seq, self_client, err = carry
         (kind, orig, off, length, seq, client, lseq, rseq, rlseq, rbits,
-         rbits2, aseq, alseq, aval) = lanes
+         rbits2, rbits3, aseq, alseq, aval) = lanes
 
         op = jnp.reshape(ops_ref[pl.ds(k, 1), :, :], (b, OP_WIDTH))
 
@@ -143,13 +143,16 @@ def _apply_values(ops_ref, tables_ref, scalars_ref):
         is_local = clientn == self_client
 
         # -- perspective (merge_kernel.perspective, mergeTree.ts:916-1004) --
-        def perspective(kind_, seq_, client_, length_, rseq_, rbits_, rbits2_):
+        def perspective(kind_, seq_, client_, length_, rseq_, rbits_,
+                        rbits2_, rbits3_):
             live = kind_ != KIND_FREE
             removed = rseq_ != RSEQ_NONE
             r_acked = removed & (rseq_ != UNASSIGNED_SEQ)
             skip = r_acked & (rseq_ <= min_seq)
             rseq_eff = jnp.where(rseq_ == UNASSIGNED_SEQ, RSEQ_NONE, rseq_)
-            removed_by_client = removed_by_slot(rbits_, rbits2_, clientn)
+            removed_by_client = removed_by_slot(
+                rbits_, rbits2_, rbits3_, clientn
+            )
             hidden = removed & ((rseq_eff <= refn) | removed_by_client)
             seq_eff = jnp.where(seq_ == UNASSIGNED_SEQ, NORM_EXISTING_LOCAL, seq_)
             ins_vis = (client_ == clientn) | (seq_eff <= refn)
@@ -160,7 +163,7 @@ def _apply_values(ops_ref, tables_ref, scalars_ref):
             return part, jnp.where(part, vis, 0)
 
         part, vis = perspective(kind, seq, client, length, rseq, rbits,
-                                rbits2)
+                                rbits2, rbits3)
         prefix = _excl_cumsum(vis)
         total = jnp.sum(vis, axis=1, keepdims=True)
         rem1 = pos1 - prefix
@@ -203,7 +206,7 @@ def _apply_values(ops_ref, tables_ref, scalars_ref):
         )
 
         lanes = [kind, orig, off, length, seq, client, lseq, rseq, rlseq,
-                 rbits, rbits2, aseq, alseq, aval]
+                 rbits, rbits2, rbits3, aseq, alseq, aval]
         I_OFF, I_LEN = 2, 3
 
         # -- split A at pos1 (insert mid-segment or range start) -----------
@@ -242,6 +245,7 @@ def _apply_values(ops_ref, tables_ref, scalars_ref):
             jnp.zeros((b, s), _I32),  # rlseq
             jnp.zeros((b, s), _I32),  # rbits
             jnp.zeros((b, s), _I32),  # rbits2
+            jnp.zeros((b, s), _I32),  # rbits3
             jnp.zeros((b, s), _I32),  # aseq
             jnp.zeros((b, s), _I32),  # alseq
             jnp.zeros((b, s), _I32),  # aval
@@ -255,11 +259,11 @@ def _apply_values(ops_ref, tables_ref, scalars_ref):
         )
 
         (kind, orig, off, length, seq, client, lseq, rseq, rlseq, rbits,
-         rbits2, aseq, alseq, aval) = lanes
+         rbits2, rbits3, aseq, alseq, aval) = lanes
 
         # -- covered rows (post-split perspective; _covered/nodeMap) -------
         part2, vis2 = perspective(kind, seq, client, length, rseq, rbits,
-                                  rbits2)
+                                  rbits2, rbits3)
         prefix2 = _excl_cumsum(vis2)
         cov = (
             part2
@@ -272,7 +276,7 @@ def _apply_values(ops_ref, tables_ref, scalars_ref):
         m_rem = cov & is_rem
         not_removed = rseq == RSEQ_NONE
         was_local = rseq == UNASSIGNED_SEQ
-        bit_lo, bit_hi = writer_bits(clientn)
+        bit_lo, bit_mid, bit_hi = writer_bits(clientn)
         rseq = jnp.where(
             m_rem & (not_removed | was_local), jnp.broadcast_to(seqn, (b, s)), rseq
         )
@@ -280,7 +284,8 @@ def _apply_values(ops_ref, tables_ref, scalars_ref):
             m_rem & not_removed & local_op, jnp.broadcast_to(lseqn, (b, s)), rlseq
         )
         rbits = jnp.where(m_rem, rbits | bit_lo, rbits)
-        rbits2 = jnp.where(m_rem, rbits2 | bit_hi, rbits2)
+        rbits2 = jnp.where(m_rem, rbits2 | bit_mid, rbits2)
+        rbits3 = jnp.where(m_rem, rbits3 | bit_hi, rbits3)
 
         # -- annotate marks (annotateRange; single-lane LWW) ---------------
         pending = alseq != 0
@@ -314,7 +319,7 @@ def _apply_values(ops_ref, tables_ref, scalars_ref):
         min_seq = jnp.maximum(min_seq, msn)
 
         lanes = [kind, orig, off, length, seq, client, lseq, rseq, rlseq,
-                 rbits, rbits2, aseq, alseq, aval]
+                 rbits, rbits2, rbits3, aseq, alseq, aval]
         return lanes, count, min_seq, cur_seq, self_client, err
 
     lanes0 = [tables_ref[i] for i in range(N_LANES)]

@@ -43,6 +43,7 @@ class SegmentState(NamedTuple):
     rlseq: jnp.ndarray  # local seq of pending remove (0 = none)
     rbits: jnp.ndarray  # bitmask of removing client slots 0-30 (removedClientIds)
     rbits2: jnp.ndarray  # bitmask of removing client slots 31-61
+    rbits3: jnp.ndarray  # bitmask of removing client slots 62-92
     aseq: jnp.ndarray  # seq of last annotate (0 = never)
     alseq: jnp.ndarray  # local seq of pending annotate (0 = none)
     aval: jnp.ndarray  # interned annotate value
@@ -66,6 +67,7 @@ SEGMENT_LANES = (
     "rlseq",
     "rbits",
     "rbits2",
+    "rbits3",
     "aseq",
     "alseq",
     "aval",
@@ -122,6 +124,7 @@ def make_state(capacity: int, self_client: int, min_seq: int = 0) -> SegmentStat
         rlseq=z(),
         rbits=z(),
         rbits2=z(),
+        rbits3=z(),
         aseq=z(),
         alseq=z(),
         aval=z(),
@@ -162,36 +165,47 @@ def grow(state: SegmentState, new_capacity: int) -> SegmentState:
     )
 
 
-def removed_by_slot(rbits, rbits2, client):
-    """Whether the writer slot appears in the two-lane removers bitmask.
-    Pure jnp (broadcastable) — shared by the XLA and Pallas perspectives;
-    host code can pass plain ints through jnp and cast the result."""
-    # Arithmetic lane select (one masked blend + one shift): Mosaic fails
-    # to lower a broadcasting select over the two shifted lanes.
+def removed_by_slot(rbits, rbits2, rbits3, client):
+    """Whether the writer slot appears in the three-lane removers bitmask
+    (slots 0-30 / 31-61 / 62-92; 31 usable bits per int32 lane keeps the
+    sign bit out of shift arithmetic). Pure jnp (broadcastable) — shared
+    by the XLA and Pallas perspectives; host code can pass plain ints
+    through jnp and cast the result."""
+    # Arithmetic lane select (masked blends + one shift): Mosaic fails to
+    # lower a broadcasting select over the shifted lanes.
     client = jnp.asarray(client, jnp.int32)
-    is_lo = (client < 31).astype(jnp.int32)
-    bits = rbits * is_lo + rbits2 * (1 - is_lo)
-    shift = jnp.clip(client - 31 * (1 - is_lo), 0, 30)
+    lane = jnp.clip(client // 31, 0, 2)
+    is0 = (lane == 0).astype(jnp.int32)
+    is1 = (lane == 1).astype(jnp.int32)
+    is2 = (lane == 2).astype(jnp.int32)
+    bits = rbits * is0 + rbits2 * is1 + rbits3 * is2
+    shift = jnp.clip(client - 31 * lane, 0, 30)
     return ((bits >> shift) & 1) == 1
 
 
-def removed_by_slot_host(rbits: int, rbits2: int, client: int) -> bool:
+def removed_by_slot_host(rbits: int, rbits2: int, rbits3: int,
+                         client: int) -> bool:
     """Host-int twin of removed_by_slot for per-row Python loops (a jnp
     call per row would cost a device dispatch each). Same slot layout —
     keep the two in this module so the mapping has one home."""
     if client < 31:
         return bool((rbits >> client) & 1)
-    return bool((rbits2 >> (client - 31)) & 1)
+    if client < 62:
+        return bool((rbits2 >> (client - 31)) & 1)
+    return bool((rbits3 >> (client - 62)) & 1)
 
 
 def writer_bits(slot):
-    """(lo, hi) single-bit masks for a writer slot: slots 0-30 set a bit in
-    the ``rbits`` lane, 31-61 in ``rbits2`` (31 usable bits per int32 lane
-    keeps the sign bit out of shift arithmetic)."""
+    """(lo, mid, hi) single-bit masks for a writer slot: slots 0-30 set a
+    bit in the ``rbits`` lane, 31-61 in ``rbits2``, 62-92 in ``rbits3``
+    (31 usable bits per int32 lane keeps the sign bit out of shift
+    arithmetic)."""
     s = jnp.asarray(slot, jnp.int32)
     lo = jnp.where(s < 31, jnp.int32(1) << jnp.clip(s, 0, 30), 0)
-    hi = jnp.where(s >= 31, jnp.int32(1) << jnp.clip(s - 31, 0, 30), 0)
-    return lo.astype(jnp.int32), hi.astype(jnp.int32)
+    mid = jnp.where((s >= 31) & (s < 62),
+                    jnp.int32(1) << jnp.clip(s - 31, 0, 30), 0)
+    hi = jnp.where(s >= 62, jnp.int32(1) << jnp.clip(s - 62, 0, 30), 0)
+    return lo.astype(jnp.int32), mid.astype(jnp.int32), hi.astype(jnp.int32)
 
 
 def adopt_client_slot(state: SegmentState, new_client_id: int) -> SegmentState:
@@ -209,15 +223,18 @@ def adopt_client_slot(state: SegmentState, new_client_id: int) -> SegmentState:
 
     pending_ins = state.seq == UNASSIGNED_SEQ
     pending_rem = state.rlseq > 0
-    old_lo, old_hi = writer_bits(state.self_client)
-    new_lo, new_hi = writer_bits(jnp.int32(new_client_id))
+    old_lo, old_mid, old_hi = writer_bits(state.self_client)
+    new_lo, new_mid, new_hi = writer_bits(jnp.int32(new_client_id))
     return state._replace(
         client=jnp.where(pending_ins, new_client_id, state.client),
         rbits=jnp.where(
             pending_rem, (state.rbits & ~old_lo) | new_lo, state.rbits
         ),
         rbits2=jnp.where(
-            pending_rem, (state.rbits2 & ~old_hi) | new_hi, state.rbits2
+            pending_rem, (state.rbits2 & ~old_mid) | new_mid, state.rbits2
+        ),
+        rbits3=jnp.where(
+            pending_rem, (state.rbits3 & ~old_hi) | new_hi, state.rbits3
         ),
         self_client=jnp.int32(new_client_id),
     )

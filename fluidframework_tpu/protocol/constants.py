@@ -48,26 +48,28 @@ F_LEN = 8  # insert length
 F_MSN = 9  # minimum sequence number rider (advances the collab window)
 OP_WIDTH = 10
 
-# Cap on concurrent writers per document: remover sets are stored as TWO
-# int32 bitmask lanes (rbits: slots 0-30, rbits2: slots 31-61; 31 usable
-# bits per lane keeps the sign bit out of the arithmetic). The reference
-# stores removedClientIds as a list (mergeTreeNodes.ts) with a 1M-client
-# config cap; 62 *concurrent* writers per document with slot recycling
-# (service/sequencer.py) covers the same sessions over time.
+# Cap on concurrent writers per document: remover sets are stored as
+# THREE int32 bitmask lanes (rbits: slots 0-30, rbits2: 31-61, rbits3:
+# 62-92; 31 usable bits per lane keeps the sign bit out of the
+# arithmetic). The reference stores removedClientIds as a list
+# (mergeTreeNodes.ts) with a 1M-client config cap; 93 *concurrent*
+# writers per document with slot recycling (service/sequencer.py) covers
+# the same sessions over time.
 #
 # SCALING STORY (the formal contract for this ceiling): the cap counts
 # SIMULTANEOUS write connections to ONE document, not sessions — slots
-# recycle on leave (sequencer.py:96-137), writer 63 gets a clean
+# recycle on leave (sequencer.py:96-137), writer 94 gets a clean
 # ERR_CLIENT + nack rather than corruption, and read connections are
 # unlimited. Widening is mechanical and O(lanes): each extra int32 lane
-# (rbits3, ...) adds 31 slots at a cost of one [D, S] lane (~4 bytes/row)
+# (rbits4, ...) adds 31 slots at a cost of one [D, S] lane (~4 bytes/row)
 # through segment_state/merge_kernel/pallas_kernel's removed_by_slot and
-# the summary lane lists — the same ~30-site pattern the rbits2 widening
-# followed (git: "Widen concurrent-writer cap to 62"). The cap is a
-# per-build constant rather than a runtime knob because lane count fixes
-# compiled kernel shapes; deployments needing more than 62 concurrent
-# writers per doc rebuild with more lanes, trading HBM per row.
-MAX_WRITERS = 62
+# the summary lane lists — the same pattern the rbits2 (r2) and rbits3
+# (r3) widenings followed. Append new lanes at the END of SEGMENT_LANES:
+# every packed index derives from that order. The cap is a per-build
+# constant rather than a runtime knob because lane count fixes compiled
+# kernel shapes; deployments needing more concurrent writers per doc
+# rebuild with more lanes, trading HBM per row.
+MAX_WRITERS = 93
 
 # Error flag bits in SegmentState.err.
 ERR_CAPACITY = 1  # segment table full; op dropped

@@ -288,18 +288,20 @@ def test_random_sequenced_stream_matches_oracle(seed):
 
 
 def test_wide_writer_slots_overlap_remove():
-    """Writer slots >= 31 land in the second removers lane (rbits2) and
-    behave identically: overlapping removes record every remover, and the
-    remover's own perspective hides the row (MAX_WRITERS = 62)."""
+    """Writer slots land across THREE removers lanes (rbits / rbits2 /
+    rbits3) and behave identically: overlapping removes record every
+    remover, and the remover's own perspective hides the row
+    (MAX_WRITERS = 93)."""
     from fluidframework_tpu.protocol.constants import MAX_WRITERS
 
-    assert MAX_WRITERS == 62
+    assert MAX_WRITERS == 93
     payloads = {1: "abcdef"}
     rows = [
         E.insert(0, 1, 6, seq=1, ref=0, client=40),
-        E.remove(1, 3, seq=2, ref=1, client=33),  # hi-lane remover
+        E.remove(1, 3, seq=2, ref=1, client=33),  # mid-lane remover
         E.remove(1, 3, seq=3, ref=1, client=2),  # lo-lane overlap
-        E.remove(3, 5, seq=4, ref=1, client=61),  # top slot
+        E.remove(1, 3, seq=4, ref=1, client=70),  # hi-lane overlap
+        E.remove(3, 5, seq=5, ref=1, client=92),  # top slot
     ]
     ops = np.stack(rows).astype(np.int32)
     st = jit_apply_ops(make_state(32, NO_CLIENT), ops)
@@ -307,22 +309,24 @@ def test_wide_writer_slots_overlap_remove():
     assert int(h.err) == 0
     assert materialize(st, payloads) == "af"
     live = [i for i in range(int(h.count)) if int(h.kind[i]) != 0]
-    # The overlapped rows carry both removers across the two lanes.
+    # The overlapped rows carry every remover across the three lanes.
     overlapped = [
         i for i in live
         if int(h.rseq[i]) == 2 and (int(h.rbits[i]) >> 2) & 1
     ]
     assert overlapped and all(
-        (int(h.rbits2[i]) >> (33 - 31)) & 1 for i in overlapped
+        (int(h.rbits2[i]) >> (33 - 31)) & 1
+        and (int(h.rbits3[i]) >> (70 - 62)) & 1
+        for i in overlapped
     )
-    top = [i for i in live if int(h.rseq[i]) == 4]
+    top = [i for i in live if int(h.rseq[i]) == 5]
     assert top and all(
-        (int(h.rbits2[i]) >> (61 - 31)) & 1 for i in top
+        (int(h.rbits3[i]) >> (92 - 62)) & 1 for i in top
     )
 
 
 def test_wide_slot_client_error_flag():
-    rows = [E.insert(0, 1, 2, seq=1, ref=0, client=62)]  # beyond the mask
+    rows = [E.insert(0, 1, 2, seq=1, ref=0, client=93)]  # beyond the mask
     st = jit_apply_ops(make_state(8, NO_CLIENT), np.stack(rows).astype(np.int32))
     from fluidframework_tpu.protocol.constants import ERR_CLIENT
 

@@ -126,9 +126,9 @@ def test_checkpoint_resume():
     assert s2.ticket(c0, op(2, 2)) is None  # dedup state survived
 
 
-def test_62_concurrent_writers_then_clean_429_and_retry():
-    """MAX_WRITERS=62 concurrent write slots (two removers-bitmask lanes);
-    the 63rd writer gets a clean 429 nack and can retry once a departed
+def test_93_concurrent_writers_then_clean_429_and_retry():
+    """MAX_WRITERS=93 concurrent write slots (three removers-bitmask lanes);
+    the 94th writer gets a clean 429 nack and can retry once a departed
     writer's slot ages past the MSN."""
     from fluidframework_tpu.protocol.constants import MAX_WRITERS
 
@@ -138,7 +138,7 @@ def test_62_concurrent_writers_then_clean_429_and_retry():
         j = s.join()
         assert j.type == MessageType.CLIENT_JOIN
         clients.append(j.contents["clientId"])
-    assert sorted(clients) == list(range(62))
+    assert sorted(clients) == list(range(93))
     overflow = s.join()
     assert isinstance(overflow, NackMessage)
     assert overflow.content_code == 429
