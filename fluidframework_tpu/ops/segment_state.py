@@ -22,7 +22,11 @@ from typing import NamedTuple
 import jax.numpy as jnp
 import numpy as np
 
-from fluidframework_tpu.protocol.constants import KIND_FREE, RSEQ_NONE
+from fluidframework_tpu.protocol.constants import (
+    KIND_FREE,
+    MAX_WRITERS,
+    RSEQ_NONE,
+)
 
 
 class SegmentState(NamedTuple):
@@ -180,7 +184,12 @@ def removed_by_slot(rbits, rbits2, rbits3, client):
     is2 = (lane == 2).astype(jnp.int32)
     bits = rbits * is0 + rbits2 * is1 + rbits3 * is2
     shift = jnp.clip(client - 31 * lane, 0, 30)
-    return ((bits >> shift) & 1) == 1
+    # Out-of-range slots (negative sentinels, >= MAX_WRITERS) must read
+    # as not-removed rather than aliasing the clipped lane's bits — the
+    # sequencer nacks writer MAX_WRITERS+, but this guard keeps the read
+    # honest for any caller.
+    in_range = (client >= 0) & (client < MAX_WRITERS)
+    return (((bits >> shift) & 1) == 1) & in_range
 
 
 def removed_by_slot_host(rbits: int, rbits2: int, rbits3: int,
@@ -188,6 +197,8 @@ def removed_by_slot_host(rbits: int, rbits2: int, rbits3: int,
     """Host-int twin of removed_by_slot for per-row Python loops (a jnp
     call per row would cost a device dispatch each). Same slot layout —
     keep the two in this module so the mapping has one home."""
+    if client < 0 or client >= MAX_WRITERS:
+        return False
     if client < 31:
         return bool((rbits >> client) & 1)
     if client < 62:

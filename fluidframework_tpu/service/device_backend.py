@@ -71,6 +71,9 @@ class DeviceFleetBackend:
         self._keys: List[ChannelKey] = []  # dense fleet id -> key
         self.payloads: Dict[ChannelKey, dict] = {}
         self.applied_seq: Dict[ChannelKey, int] = {}
+        # Highest seq sitting in _buffers per channel (drops live
+        # redelivery duplicates before they double-apply).
+        self._buffered_seq: Dict[ChannelKey, int] = {}
         self._buffers: Dict[int, List[np.ndarray]] = {}
         self._buffered_rows = 0
         self._flushes = 0
@@ -120,12 +123,18 @@ class DeviceFleetBackend:
 
     def enqueue(self, doc_id: str, address: str, row: np.ndarray) -> None:
         """Buffer one sequenced kernel row. Rows at or below the channel's
-        applied watermark are replay duplicates and drop here (idempotence
-        under at-least-once delivery)."""
+        applied watermark — OR its buffered high-water mark — are replay
+        duplicates and drop here (idempotence under at-least-once
+        delivery must hold for live redelivery of a still-buffered row,
+        not just for rows already flushed)."""
         key = (doc_id, address)
         idx = self.ensure(doc_id, address)
-        if int(row[F_SEQ]) <= self.applied_seq[key]:
+        seq = int(row[F_SEQ])
+        if seq <= self.applied_seq[key] or seq <= self._buffered_seq.get(
+            key, 0
+        ):
             return
+        self._buffered_seq[key] = seq
         self._buffers.setdefault(idx, []).append(row)
         self._buffered_rows += 1
         if self._buffered_rows >= self.max_batch:

@@ -25,9 +25,10 @@ the service path, not a harness.
 
 from __future__ import annotations
 
+import functools
 import json
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,12 +39,70 @@ import numpy as np
 # The row dimension truncates ON DEVICE to the dirty set's max count
 # bucket before the host transfer — summaries only need rows below each
 # doc's high-water mark, so shipping full capacity wastes ~8x the bytes.
-_gather_docs = jax.jit(
-    lambda tables, idx, rows: jnp.take(tables, idx, axis=1)[:, :, :rows],
-    static_argnums=(2,),
-)
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _scribe_gather(tables, scalars, idx, u8, m32, rows):
+    """Device half of one scribe bucket. Gathers the dirty docs' tables,
+    truncates rows to the bucket, and produces the ONE flat int8 buffer
+    that crosses the tunnel (the link moves single-digit MB/s, so bytes
+    ARE the scribe's cost model):
 
-from fluidframework_tpu.ops.pallas_compact import compact_packed
+    - the ``u8`` lanes affine-encode as ``value - doc_lane_base - 128``
+      int8 with PER-DOCUMENT bases (a document's live rows span a narrow
+      value window even when the fleet's spans are huge; rseq's RSEQ_NONE
+      sentinel maps to code 254);
+    - the ``m32`` (bitmask) lanes ride verbatim int32, followed by the
+      bases, the [L] lane-occupancy witness, the range-fit flag, and the
+      gathered scalar rows — everything small piggybacks on the big
+      transfer instead of paying the per-copy floor, bitcast into the
+      int8 stream.
+
+    Occupancy is judged against each lane's canonical background so
+    unoccupied lanes can be dropped and reconstructed at load; the fit
+    flag guards the affine encoding (a failed check re-gathers THAT
+    bucket verbatim host-side)."""
+    sub = jnp.take(tables, idx, axis=1)[:, :, :rows]  # [L, nb, rows]
+    counts = jnp.take(scalars[:, SC_COUNT], idx, axis=0)
+    live = jnp.arange(rows)[None, :] < counts[:, None]
+    defaults = jnp.asarray(_LANE_DEFAULTS_HOST)  # trace-time constant
+    occ = jnp.any(
+        (sub != defaults[:, None, None]) & live[None], axis=(1, 2)
+    )
+    scal_sub = jnp.take(scalars, idx, axis=0)  # [nb, S]
+    big = jnp.int32(2**31 - 1)
+    if u8:
+        su = sub[jnp.asarray(u8)]  # [L8, nb, rows]
+        is_rseq = jnp.asarray(
+            [SEGMENT_LANES[i] == "rseq" for i in u8], bool
+        )[:, None, None]
+        sent = (su == RSEQ_NONE) & is_rseq
+        val_ok = live[None] & ~sent
+        lo = jnp.where(val_ok, su, big).min(axis=2)     # [L8, nb]
+        hi = jnp.where(val_ok, su, -big).max(axis=2)
+        base = jnp.where(hi >= lo, lo, 0)
+        fits = jnp.all(jnp.where(hi >= lo, hi - base, 0) < 254)
+        u = jnp.where(sent, 254, su - base[:, :, None])
+        enc8 = (u - 128).astype(jnp.int8).reshape(-1)
+    else:
+        base = jnp.zeros((0, idx.shape[0]), jnp.int32)
+        fits = jnp.bool_(True)
+        enc8 = jnp.zeros((0,), jnp.int8)
+    masks = (
+        sub[jnp.asarray(m32)].reshape(-1)
+        if m32 else jnp.zeros((0,), jnp.int32)
+    )
+    i32 = jnp.concatenate(
+        [
+            masks,
+            base.reshape(-1).astype(jnp.int32),
+            occ.astype(jnp.int32),
+            fits.astype(jnp.int32)[None],
+            scal_sub.reshape(-1).astype(jnp.int32),
+        ]
+    )
+    tail = jax.lax.bitcast_convert_type(i32, jnp.int8).reshape(-1)
+    return jnp.concatenate([enc8, tail])
+
+from fluidframework_tpu.ops.pallas_compact import apply_compact_packed
 from fluidframework_tpu.ops.pallas_kernel import (
     SC_COUNT,
     SC_CUR_SEQ,
@@ -61,14 +120,111 @@ from fluidframework_tpu.ops.segment_state import (
 )
 from fluidframework_tpu.protocol.constants import (
     F_CLIENT,
+    F_LSEQ,
     F_MSN,
+    F_POS1,
+    F_POS2,
+    F_ARG,
+    F_LEN,
     F_REF,
     F_SEQ,
+    F_TYPE,
     NO_CLIENT,
     OP_WIDTH,
 )
+from fluidframework_tpu.protocol.constants import RSEQ_NONE
 from fluidframework_tpu.service.fleet_sequencer import FleetSequencer
 from fluidframework_tpu.service.summary_store import SummaryStore
+from fluidframework_tpu.utils import pow2_at_least as _pow2_at_least
+
+# Canonical background per lane: a live row whose lane equals this value
+# carries no information (never-removed rows hold RSEQ_NONE, every other
+# lane zero) — such lanes are dropped from the transfer and reconstructed
+# at load time.
+_LANE_DEFAULTS_HOST = np.asarray(
+    [RSEQ_NONE if name == "rseq" else 0 for name in SEGMENT_LANES],
+    np.int32,
+)
+
+# Bitmask lanes carry full 31-bit removed-by sets — they ship verbatim
+# int32; every other lane affine-encodes into the uint16 window.
+_MASK_LANE_IDX = frozenset(
+    i for i, name in enumerate(SEGMENT_LANES) if name.startswith("rbits")
+)
+_RSEQ_IDX = SEGMENT_LANES.index("rseq")
+
+
+def _split_lane_set(lane_set):
+    """Partition a shipped-lane tuple into (u16 affine lanes, int32
+    verbatim lanes)."""
+    u16 = tuple(i for i in lane_set if i not in _MASK_LANE_IDX)
+    m32 = tuple(i for i in lane_set if i in _MASK_LANE_IDX)
+    return u16, m32
+
+
+def _pick_width(lo: int, hi: int) -> int:
+    if -128 <= lo and hi <= 127:
+        return 1
+    if -32768 <= lo and hi <= 32767:
+        return 2
+    return 4
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _expand_wire(buf, widths, d, k):
+    """Inflate the width-adaptive op wire back to kernel rows ON DEVICE.
+    ``buf`` is ONE flat int8 upload: eight planar field segments — (type,
+    pos1, pos2, arg, len, client, ref_delta, msn_delta), each at the
+    narrowest of int8/int16/int32 that held the round's range (host-
+    checked) — followed by a [D, 2] int32 (seq0, alive) base block. Seq is
+    synthesized from each doc's first stamped seq (the deli boxcar stamp
+    rule: consecutive seqs per doc per round), ref/msn rebased off the
+    same base, lseq pinned 0 (sequenced remote ops carry no local seq).
+    A refused doc's rows are zeroed host-side and ``alive`` zeroes its
+    stamps so the kernel sees pure NOOPs. Sub-5-byte ops matter because
+    the host link moves single-digit MB/s: upload width IS serving
+    throughput."""
+    cols = []
+    o = 0
+    for w in widths:
+        n = d * k * w
+        seg = buf[o: o + n]
+        o += n
+        if w == 1:
+            v = seg.astype(jnp.int32)
+        elif w == 2:
+            v = jax.lax.bitcast_convert_type(
+                seg.reshape(-1, 2), jnp.int16
+            ).astype(jnp.int32)
+        else:
+            v = jax.lax.bitcast_convert_type(seg.reshape(-1, 4), jnp.int32)
+        cols.append(v.reshape(d, k))
+    base = jax.lax.bitcast_convert_type(
+        buf[o: o + d * 8].reshape(-1, 4), jnp.int32
+    ).reshape(d, 2)
+    ty, pos1, pos2, arg, ln, client, ref_d, msn_d = cols
+    seq0 = base[:, 0][:, None]
+    alive = base[:, 1][:, None]
+    seq = (seq0 + jnp.arange(k, dtype=jnp.int32)[None, :]) * alive
+    z = jnp.zeros((d, k), jnp.int32)
+    out = [
+        ty,                         # F_TYPE
+        pos1,                       # F_POS1
+        pos2,                       # F_POS2
+        seq,                        # F_SEQ
+        (seq0 + ref_d) * alive,     # F_REF
+        client,                     # F_CLIENT
+        z,                          # F_LSEQ
+        arg,                        # F_ARG
+        ln,                         # F_LEN
+        (seq0 + msn_d) * alive,     # F_MSN
+    ]
+    return jnp.stack(out, axis=-1)
+
+
+_scan_slim = jax.jit(
+    lambda s: jnp.stack([s[:, SC_COUNT], s[:, SC_CUR_SEQ]], axis=1)
+)
 
 
 class TpuFleetService:
@@ -97,9 +253,25 @@ class TpuFleetService:
         self.rounds_applied = 0
         self.summary_writes = 0
         self.last_ticket_s = 0.0  # host ticket-loop time of the last round
+        self.wire16_rounds = 0  # rounds shipped on the packed op wire
+        self.wire32_rounds = 0  # rounds that fell back to verbatim int32
+        # Sticky per-field wire widths (monotone widening — see
+        # _upload_round).
+        self._wire_widths = (1,) * 8
         # Device-scribe watermark: last summarized seq per doc (host [D]).
         self._summarized_seq = np.zeros(n_docs, np.int64)
-        self._summary_handles: Dict[int, str] = {}
+        # doc -> (pack handle, byte offset, lanes tuple, bucket rows,
+        # count, min_seq, cur_seq): the pack-blob index (git packfile
+        # analog — one content-addressed blob per sweep, per-doc summaries
+        # are slices into it).
+        self._summary_handles: Dict[int, tuple] = {}
+        # Adaptive lane set: lanes shipped per sweep. Grows the moment the
+        # occupancy witness shows a lane outside the set went live (that
+        # sweep re-gathers in full); shrinks only after a lane has read
+        # unoccupied for 3 consecutive sweeps (oscillation guard).
+        self._lane_set: Tuple[int, ...] = tuple(range(len(SEGMENT_LANES)))
+        self._lane_idle = np.zeros(len(SEGMENT_LANES), np.int32)
+        self.last_summary_breakdown: Dict[str, float] = {}
 
     # -- front door ------------------------------------------------------------
 
@@ -120,6 +292,17 @@ class TpuFleetService:
         the slow path; its rows are NOT applied) and the sequenced rows as
         applied (refused docs zeroed to NOOPs) — what scriptorium/logTail
         persistence must record."""
+        return self.commit_round(self.stage_round(intents, rows))
+
+    def stage_round(
+        self, intents: np.ndarray, rows: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, object]:
+        """Ticket + stamp one boxcar and START its device upload (async).
+        Returns an opaque token for :meth:`commit_round`. Splitting the
+        phases lets the serving loop stream round r+1's upload while
+        round r's scribe readback is still draining — the tunnel is
+        full-duplex (measured: overlapped H2D+D2H runs ~2x faster than
+        serial)."""
         t0 = time.perf_counter()
         out, err = self.fseq.ticket_batch(intents)
         self.last_ticket_s = time.perf_counter() - t0
@@ -130,17 +313,81 @@ class TpuFleetService:
         rows[:, :, F_CLIENT] = intents[:, :, 0]
         if err.any():
             rows[err != 0] = 0  # refused documents apply nothing (NOOPs)
-        jops = jax.device_put(rows)
-        self.tables, self.scalars = apply_ops_packed(
-            self.tables, self.scalars, jops,
-            block_docs=self.block_docs, interpret=self.interpret,
-        )
-        self.rounds_applied += 1
-        if self.rounds_applied % self.compact_every == 0:
-            self.tables, self.scalars = compact_packed(
-                self.tables, self.scalars, interpret=self.interpret
+        jops = self._upload_round(rows, out, err)
+        return (err, rows, jops)
+
+    def commit_round(self, token) -> Tuple[np.ndarray, np.ndarray]:
+        """Dispatch the staged boxcar's fused device apply."""
+        err, rows, jops = token
+        compact_due = (self.rounds_applied + 1) % self.compact_every == 0
+        if compact_due:
+            self.tables, self.scalars = apply_compact_packed(
+                self.tables, self.scalars, jops,
+                block_docs=self.block_docs, interpret=self.interpret,
             )
+        else:
+            self.tables, self.scalars = apply_ops_packed(
+                self.tables, self.scalars, jops,
+                block_docs=self.block_docs, interpret=self.interpret,
+            )
+        self.rounds_applied += 1
         return err, rows
+
+    def _upload_round(self, rows: np.ndarray, out: np.ndarray,
+                      err: np.ndarray):
+        """Ship one stamped boxcar to the device. Fast path: the width-
+        adaptive planar wire (one flat int8 buffer, each field at the
+        narrowest dtype holding the round's range — typically ~8 bytes/op
+        against the verbatim wire's 40) with seq stamps synthesized on
+        device; any structural mismatch falls back to the verbatim int32
+        upload for the whole round (counted, never silent)."""
+        d, k = rows.shape[0], rows.shape[1]
+        seq0 = out[:, 0, 0].astype(np.int64)
+        alive = (err == 0).astype(np.int64)
+        ref_d = (
+            rows[:, :, F_REF].astype(np.int64) - seq0[:, None]
+        ) * alive[:, None]
+        msn_d = (
+            rows[:, :, F_MSN].astype(np.int64) - seq0[:, None]
+        ) * alive[:, None]
+        seq_ok = (
+            rows[:, :, F_SEQ]
+            == (seq0[:, None] + np.arange(k)) * alive[:, None]
+        ).all()
+        if not (
+            seq_ok
+            and (rows[:, :, F_LSEQ] == 0).all()
+            and seq0.max() < 2**31 - k
+        ):
+            self.wire32_rounds += 1
+            return jax.device_put(rows)
+        self.wire16_rounds += 1
+        fields = [
+            rows[:, :, F_TYPE], rows[:, :, F_POS1], rows[:, :, F_POS2],
+            rows[:, :, F_ARG], rows[:, :, F_LEN], rows[:, :, F_CLIENT],
+            ref_d, msn_d,
+        ]
+        segs: List[np.ndarray] = []
+        widths: List[int] = []
+        dts = {1: np.int8, 2: np.int16, 4: np.int32}
+        for i, f in enumerate(fields):
+            # Sticky monotone widths: widening only. Re-picking the
+            # narrowest width each round would flip the jitted expand's
+            # static widths tuple whenever a field drifts across a dtype
+            # boundary — a multi-second XLA recompile on the hot path.
+            w = max(
+                _pick_width(int(f.min()), int(f.max())),
+                self._wire_widths[i],
+            )
+            widths.append(w)
+            segs.append(
+                np.ascontiguousarray(f.astype(dts[w])).view(np.int8).ravel()
+            )
+        self._wire_widths = tuple(widths)
+        base = np.stack([seq0, alive], axis=1).astype(np.int32)
+        segs.append(base.view(np.int8).ravel())
+        buf = np.concatenate(segs)
+        return _expand_wire(jax.device_put(buf), tuple(widths), d, k)
 
     # -- error / read surface --------------------------------------------------
 
@@ -158,92 +405,318 @@ class TpuFleetService:
 
     # -- the device scribe -----------------------------------------------------
 
+    def begin_summarize_dirty(
+        self, threshold: int = 1, max_docs: Optional[int] = None
+    ) -> "_PendingSummary":
+        """Start a scribe sweep without blocking: the [D, 2] (count,
+        cur_seq) scan — the dirtiness + bucketing signal, sliced on
+        device so only two columns cross the link — streams to host in
+        the background while the caller stages other work. Follow with
+        ``stage()`` then ``finish()`` on the returned token
+        (``summarize_dirty`` is the sync wrapper)."""
+        return _PendingSummary(self, threshold, max_docs)
+
     def summarize_dirty(
         self, threshold: int = 1, max_docs: Optional[int] = None
     ) -> Tuple[int, int]:
         """Produce summaries for every document whose device state advanced
         >= ``threshold`` seqs past its last summary. Dirtiness is ONE [D]
-        scalar readback; only dirty docs' lane tables transfer (device
-        gather first, so the tunnel moves exactly the dirty slices).
+        scalar readback; only dirty docs' lane tables transfer — gathered
+        on device into per-count-bucket slabs, pruned to the occupied lane
+        set, and serialized as ONE content-addressed pack blob per sweep
+        (one store write + one hash; ``scribe/summaryWriter.ts``'s git-tree
+        write batched the way git packs objects).
         Returns (docs_summarized, total_bytes)."""
-        scal_all = np.asarray(self.scalars)  # [D, N_SCALARS], shape-stable
-        cur = scal_all[:, SC_CUR_SEQ].astype(np.int64)
-        dirty = np.flatnonzero(cur - self._summarized_seq >= threshold)
-        if max_docs is not None:
-            dirty = dirty[:max_docs]
-        if dirty.size == 0:
-            return 0, 0
-        # Pad the gather index to a bucketed size: the device gather then
-        # compiles once per bucket instead of once per dirty count (each
-        # fresh compile costs seconds through the tunnel). Power-of-two up
-        # to 4096, then 4096-granular — pow2 padding at fleet scale would
-        # nearly double the readback bytes.
-        padded = 1
-        while padded < min(dirty.size, 4096):
-            padded *= 2
-        if dirty.size > 4096:
-            padded = ((dirty.size + 4095) // 4096) * 4096
-        idx = np.full(padded, dirty[0], np.int32)
-        idx[: dirty.size] = dirty
-        scal = scal_all[dirty]
-        # Row bucket: pow2 >= the dirty set's max live rows (counts are
-        # already on host), capped at capacity.
-        rows = 8
-        max_count = int(scal[:, SC_COUNT].max())
-        while rows < min(max_count, self.capacity):
-            rows *= 2
-        rows = min(rows, self.capacity)
-        slices = np.asarray(
-            _gather_docs(self.tables, jax.device_put(idx), rows)
-        )[:, : dirty.size]
-        total = 0
-        for j, d in enumerate(dirty):
-            blob = self._serialize_doc(int(d), slices[:, j], scal[j])
-            handle = self.store.put_blob(blob)
-            self._summary_handles[int(d)] = handle
-            total += len(blob)
-        self._summarized_seq[dirty] = cur[dirty]
-        self.summary_writes += dirty.size
-        return int(dirty.size), total
+        pend = self.begin_summarize_dirty(threshold, max_docs)
+        pend.stage()
+        return pend.finish()
 
     def latest_summary(self, doc: int) -> Optional[dict]:
-        """Load a document's latest device-produced summary blob."""
-        handle = self._summary_handles.get(doc)
-        if handle is None:
+        """Load a document's latest device-produced summary: one slice out
+        of its sweep's pack blob, re-inflated to the client
+        ``summarize_core`` lane format (dropped lanes reconstruct as their
+        canonical background — the occupancy witness guaranteed they held
+        no information)."""
+        entry = self._summary_handles.get(doc)
+        if entry is None:
             return None
-        return self._deserialize_doc(self.store.get_blob(handle))
-
-    @staticmethod
-    def _serialize_doc(doc: int, lanes: np.ndarray, scalars: np.ndarray):
-        """Compact binary: header JSON line + raw int32 lane block (only
-        rows below the doc's count high-water mark)."""
-        n = int(scalars[SC_COUNT])
-        head = json.dumps(
-            {
-                "doc": doc,
-                "count": n,
-                "min_seq": int(scalars[SC_MIN_SEQ]),
-                "cur_seq": int(scalars[SC_CUR_SEQ]),
-                "lanes": list(SEGMENT_LANES),
-            },
-            separators=(",", ":"),
-        ).encode()
-        return head + b"\n" + np.ascontiguousarray(lanes[:, :n]).tobytes()
-
-    @staticmethod
-    def _deserialize_doc(blob: bytes) -> dict:
-        head, raw = blob.split(b"\n", 1)
-        meta = json.loads(head)
-        n = meta["count"]
-        lanes = np.frombuffer(raw, np.int32).reshape(len(meta["lanes"]), n)
+        (handle, u8, m32, rows, o8, o32, ob, count, min_seq,
+         cur_seq) = entry
+        pack = self.store.get_blob(handle)
+        lanes = {
+            name: [int(_LANE_DEFAULTS_HOST[i])] * count
+            for i, name in enumerate(SEGMENT_LANES)
+        }
+        if u8:
+            b8 = np.frombuffer(
+                pack, np.int8, count=len(u8) * rows, offset=o8
+            ).reshape(len(u8), rows)[:, :count]
+            bases = np.frombuffer(
+                pack, np.int32, count=len(u8), offset=ob
+            )
+            u = b8.astype(np.int64) + 128
+            for j, li in enumerate(u8):
+                vals = u[j] + bases[j]
+                if li == _RSEQ_IDX:
+                    vals = np.where(u[j] == 254, RSEQ_NONE, vals)
+                lanes[SEGMENT_LANES[li]] = vals.astype(int).tolist()
+        if m32:
+            b32 = np.frombuffer(
+                pack, np.int32, count=len(m32) * rows, offset=o32
+            ).reshape(len(m32), rows)[:, :count]
+            for j, li in enumerate(m32):
+                lanes[SEGMENT_LANES[li]] = b32[j].tolist()
         return {
-            "lanes": {
-                name: lanes[i].tolist()
-                for i, name in enumerate(meta["lanes"])
-            },
-            "count": n,
-            "min_seq": meta["min_seq"],
-            "cur_seq": meta["cur_seq"],
+            "lanes": lanes,
+            "count": count,
+            "min_seq": min_seq,
+            "cur_seq": cur_seq,
             "payloads": {},
             "intervals": {},
         }
+
+
+class _PendingSummary:
+    """One in-flight scribe sweep: ``begin`` started the dirtiness
+    readback, ``stage()`` dispatches the bucket gathers and starts their
+    device->host copies, ``finish()`` waits, serializes the pack blob, and
+    commits the watermark. Splitting the phases lets the serving loop put
+    host staging (and the next round's device dispatch) between the
+    transfer start and the transfer wait — the tunnel streams while the
+    host works."""
+
+    def __init__(self, svc: TpuFleetService, threshold: int,
+                 max_docs: Optional[int]):
+        self.svc = svc
+        self.threshold = threshold
+        self.max_docs = max_docs
+        self.t_begin = time.perf_counter()
+        self._staged = False
+        self._buckets: List[tuple] = []  # (rows, docs, padded, dev)
+        self._dirty = None
+        self._cur = None
+        # Snapshot the device arrays NOW: the serving loop may dispatch
+        # the next round's apply (replacing svc.tables/scalars) between
+        # stage() and finish(), and this sweep must describe one
+        # consistent state.
+        self._tables = svc.tables
+        self._scalars = svc.scalars
+        self._scan = _scan_slim(svc.scalars)
+        self._scan.copy_to_host_async()
+        self.breakdown: Dict[str, float] = {}
+
+    def stage(self) -> None:
+        svc = self.svc
+        t0 = time.perf_counter()
+        scan = np.asarray(self._scan)  # waits on the async copy
+        t1 = time.perf_counter()
+        cur = scan[:, 1].astype(np.int64)
+        backlog = cur - svc._summarized_seq
+        dirty = np.flatnonzero(backlog >= self.threshold)
+        if self.max_docs is not None and dirty.size > self.max_docs:
+            # Most-behind-first: the scribe serves the largest backlog, so
+            # a capped cadence still rotates the whole fleet instead of
+            # re-summarizing whichever docs sort first.
+            top = np.argpartition(-backlog[dirty], self.max_docs - 1)
+            dirty = dirty[np.sort(top[: self.max_docs])]
+        self._dirty = dirty
+        self._cur = cur
+        self._staged = True
+        if dirty.size == 0:
+            self.breakdown = {"scan_ms": (t1 - t0) * 1e3}
+            return
+        # Bucket dirty docs by pow2(exact live rows): each bucket
+        # transfers at its own row width, so a fleet of mostly-small docs
+        # doesn't pay the largest doc's width (the tunnel's ~10-20 MB/s
+        # is the whole cost model here). Floor 16 keeps the shape set
+        # small — an extra bucket costs a whole transfer's fixed floor.
+        buckets: Dict[int, np.ndarray] = {}
+        c = np.maximum(scan[dirty, 0].astype(np.int64), 1)
+        rb = (1 << np.ceil(np.log2(c)).astype(np.int64))
+        # Floor BEFORE the capacity cap: a capacity-8 service must bucket
+        # at 8, not at a floor above its own table depth.
+        rb = np.minimum(np.maximum(rb, 16), svc.capacity)
+        for r in np.unique(rb):
+            buckets[int(r)] = dirty[rb == r]
+        u8, m32 = _split_lane_set(svc._lane_set)
+        for rows, docs in sorted(buckets.items()):
+            padded = _pow2_at_least(docs.size)
+            if docs.size > 4096:
+                padded = ((docs.size + 4095) // 4096) * 4096
+            idx = np.full(padded, docs[0], np.int32)
+            idx[: docs.size] = docs
+            dev = _scribe_gather(
+                self._tables, self._scalars, jax.device_put(idx), u8, m32,
+                rows,
+            )
+            dev.copy_to_host_async()
+            self._buckets.append((rows, docs, padded, dev))
+        self._u8, self._m32 = u8, m32
+        t2 = time.perf_counter()
+        self.breakdown = {
+            "scan_ms": (t1 - t0) * 1e3,
+            "dispatch_ms": (t2 - t1) * 1e3,
+        }
+
+    def finish(self) -> Tuple[int, int]:
+        if not self._staged:
+            self.stage()
+        svc = self.svc
+        dirty = self._dirty
+        if dirty.size == 0:
+            return 0, 0
+        u8, m32 = self._u8, self._m32
+        L = len(SEGMENT_LANES)
+        S = int(self._scalars.shape[1])
+        t0 = time.perf_counter()
+
+        def parse(buf, rows, padded, nb, u8, m32):
+            """Split one bucket's flat int8 transfer back into
+            (enc8, masks, base, occ, fits, scal)."""
+            n8 = len(u8) * padded * rows
+            enc8 = (
+                buf[:n8].reshape(len(u8), padded, rows)[:, :nb]
+                if u8 else np.zeros((0, nb, rows), np.int8)
+            )
+            i32 = np.ascontiguousarray(buf[n8:]).view(np.int32)
+            o = len(m32) * padded * rows
+            masks = i32[:o].reshape(len(m32), padded, rows)[:, :nb]
+            base = i32[o: o + len(u8) * padded].reshape(
+                len(u8), padded
+            )[:, :nb]
+            o += len(u8) * padded
+            occ = i32[o: o + L].astype(bool)
+            fits = bool(i32[o + L])
+            scal = i32[o + L + 1:].reshape(padded, S)[:nb]
+            return enc8, masks, base, occ, fits, scal
+
+        def regather(rows, docs, padded, u8, m32):
+            """Synchronous verbatim re-gather of one bucket."""
+            idx = np.full(padded, docs[0], np.int32)
+            idx[: docs.size] = docs
+            dev = _scribe_gather(
+                self._tables, self._scalars, jax.device_put(idx),
+                u8, m32, rows,
+            )
+            return parse(np.asarray(dev), rows, padded, docs.size, u8, m32)
+
+        # host_buckets: (rows, docs, lanes=(u8, m32), enc8 [L8,nb,rows],
+        #                masks [L32,nb,rows], base [L8,nb], scal [nb,S])
+        host_buckets = []
+        occ_union = np.zeros(L, bool)
+        regathers = 0
+        for rows, docs, padded, dev in self._buckets:
+            buf = np.asarray(dev)
+            enc8, masks, base, occ, f, scal = parse(
+                buf, rows, padded, docs.size, u8, m32
+            )
+            occ_union |= occ
+            if not f:
+                # This bucket's live range overflowed the int8 window:
+                # re-gather IT verbatim; other buckets keep the fast path.
+                enc8, masks, base, _occ, _f, scal = regather(
+                    rows, docs, padded, (), tuple(range(L))
+                )
+                regathers += 1
+                host_buckets.append(
+                    (rows, docs, ((), tuple(range(L))), enc8, masks, base,
+                     scal)
+                )
+            else:
+                host_buckets.append(
+                    (rows, docs, (u8, m32), enc8, masks, base, scal)
+                )
+        t1 = time.perf_counter()
+        needed = np.flatnonzero(occ_union)
+        missing = [li for li in needed if li not in svc._lane_set]
+        if missing:
+            # A lane outside the shipped set went live: re-gather the
+            # sweep with every lane verbatim (correctness over speed —
+            # rare by construction) and reset the adaptive state.
+            full = tuple(range(L))
+            host_buckets = []
+            for rows, docs, padded, _dev in self._buckets:
+                enc8, masks, base, _occ, _f, scal = regather(
+                    rows, docs, padded, (), full
+                )
+                regathers += 1
+                host_buckets.append(
+                    (rows, docs, ((), full), enc8, masks, base, scal)
+                )
+            svc._lane_set = full
+            svc._lane_idle[:] = 0
+        else:
+            # Shrink lanes idle for 3 consecutive sweeps (oscillation
+            # guard); grow is handled by the regather branch.
+            svc._lane_idle[~occ_union] += 1
+            svc._lane_idle[occ_union] = 0
+            keep = tuple(
+                li for li in svc._lane_set
+                if occ_union[li] or svc._lane_idle[li] < 3
+            )
+            svc._lane_set = keep if keep else (0,)
+        # Serialize ONE pack blob for the whole sweep (git-packfile analog:
+        # one store write, one content hash). Layout per bucket: int64
+        # [n, 4] doc meta, int32 [n, L8] per-doc bases, int8 [n, L8, rows]
+        # encoded lanes, int32 [n, L32, rows] verbatim lanes.
+        t2 = time.perf_counter()
+        parts: List[bytes] = []
+        bucket_meta = []
+        off = 0
+        for rows, docs, (bu8, bm32), enc8, masks, base, scal in (
+            host_buckets
+        ):
+            nb = docs.size
+            meta = np.empty((nb, 4), np.int64)
+            meta[:, 0] = docs
+            meta[:, 1] = scal[:, SC_COUNT]
+            meta[:, 2] = scal[:, SC_MIN_SEQ]
+            meta[:, 3] = scal[:, SC_CUR_SEQ]
+            bb = np.ascontiguousarray(base.T)  # [nb, L8] int32
+            b8 = np.ascontiguousarray(enc8.transpose(1, 0, 2))
+            b32 = np.ascontiguousarray(masks.transpose(1, 0, 2))
+            ob = off + meta.nbytes
+            o8 = ob + bb.nbytes
+            o32 = o8 + b8.nbytes
+            bucket_meta.append(
+                {"rows": rows, "n": nb, "u8": list(bu8),
+                 "m32": list(bm32), "offb": ob, "off8": o8, "off32": o32}
+            )
+            parts += [meta.tobytes(), bb.tobytes(), b8.tobytes(),
+                      b32.tobytes()]
+            off = o32 + b32.nbytes
+        head = json.dumps(
+            {"v": 4, "buckets": bucket_meta}, separators=(",", ":"),
+        ).encode() + b"\n"
+        pack = head + b"".join(parts)
+        t3 = time.perf_counter()
+        handle = svc.store.put_blob(pack)
+        t4 = time.perf_counter()
+        hb = len(head)
+        for (rows, docs, (bu8, bm32), enc8, masks, base, scal), bm in zip(
+            host_buckets, bucket_meta
+        ):
+            s8, s32 = len(bu8) * rows, len(bm32) * rows * 4
+            sb = len(bu8) * 4
+            o8, o32 = hb + bm["off8"], hb + bm["off32"]
+            ob = hb + bm["offb"]
+            for j in range(docs.size):
+                svc._summary_handles[int(docs[j])] = (
+                    handle, bu8, bm32, rows, o8 + j * s8,
+                    o32 + j * s32, ob + j * sb, int(scal[j, SC_COUNT]),
+                    int(scal[j, SC_MIN_SEQ]), int(scal[j, SC_CUR_SEQ]),
+                )
+        svc._summarized_seq[dirty] = self._cur[dirty]
+        svc.summary_writes += int(dirty.size)
+        t5 = time.perf_counter()
+        self.breakdown.update(
+            transfer_ms=(t1 - t0) * 1e3,
+            regathers=regathers,
+            serialize_ms=(t3 - t2) * 1e3,
+            store_ms=(t4 - t3) * 1e3,
+            index_ms=(t5 - t4) * 1e3,
+            lanes_shipped=len(u8) + len(m32),
+            pack_bytes=len(pack),
+        )
+        svc.last_summary_breakdown = dict(self.breakdown)
+        return int(dirty.size), len(pack)

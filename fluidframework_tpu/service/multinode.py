@@ -369,6 +369,12 @@ class NodeCluster:
             if not hot.release_doc(doc_id):
                 break
             if not cold.try_own(doc_id):  # pragma: no cover - cold is live
+                # The voluntary surrender went through but the takeover
+                # didn't: re-own on the hot node (or via the cluster's
+                # normal owner() election) so the document is never left
+                # unowned by a failed migration attempt.
+                if not hot.try_own(doc_id):
+                    self.owner(doc_id)
                 break
             moves.append((doc_id, hot.name, cold.name))
         for n in self.nodes:

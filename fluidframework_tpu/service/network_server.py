@@ -441,6 +441,13 @@ class FluidNetworkServer:
                 if ranged is not None and head is not None:
                     msgs = ranged(s.push_doc, s.push_seq + 1, head)
                 else:
+                    # No head probe on this service: the fallback scans
+                    # (sorts/filters) the whole per-doc log, so gate it
+                    # to every 8th tick — bounded extra latency instead
+                    # of O(log) work on every idle drain.
+                    s.push_scan_tick = getattr(s, "push_scan_tick", 0) + 1
+                    if head is None and s.push_scan_tick % 8 != 1:
+                        continue
                     msgs = self.service.get_deltas(
                         s.push_doc, from_seq=s.push_seq
                     )

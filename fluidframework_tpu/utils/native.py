@@ -35,13 +35,21 @@ def _load_lib(so_name: str) -> Optional[ctypes.CDLL]:
     # not checked in, and a stale .so must never shadow source changes.
     stale = not os.path.exists(so)
     if not stale:
-        so_mtime = os.path.getmtime(so)
-        for f in os.listdir(_NATIVE_DIR):
-            if (f.endswith((".cpp", ".h", ".hpp")) or f == "Makefile") and (
-                os.path.getmtime(os.path.join(_NATIVE_DIR, f)) > so_mtime
-            ):
-                stale = True
-                break
+        try:
+            so_mtime = os.path.getmtime(so)
+            for f in os.listdir(_NATIVE_DIR):
+                if (
+                    f.endswith((".cpp", ".h", ".hpp")) or f == "Makefile"
+                ) and (
+                    os.path.getmtime(os.path.join(_NATIVE_DIR, f))
+                    > so_mtime
+                ):
+                    stale = True
+                    break
+        except OSError:
+            # A file vanishing mid-scan (concurrent make clean) means we
+            # cannot trust the staleness verdict — rebuild.
+            stale = True
     if stale:
         try:
             subprocess.run(

@@ -1,0 +1,160 @@
+"""Adaptive scribe transfer + op wire fallbacks (round 4).
+
+The fleet service's serving throughput is bounded by the host<->device
+link, so both directions run compressed fast paths with correctness
+escape hatches:
+
+- the op UPLOAD ships a width-adaptive planar wire with device-side seq
+  synthesis, falling back to the verbatim int32 rows whenever any field
+  leaves its window (``TpuFleetService._upload_round``);
+- the summary DOWNLOAD ships per-doc int8 affine-encoded lanes pruned to
+  the occupied set, re-gathering verbatim when a lane's live range
+  overflows int8 or a pruned lane goes live
+  (``_PendingSummary.finish``).
+
+These tests pin every fallback edge: the fast path must never be wrong,
+and the fallbacks must never be silent.
+"""
+
+import numpy as np
+
+from fluidframework_tpu.ops import encode as E
+from fluidframework_tpu.protocol.constants import (
+    F_REF,
+    OP_WIDTH,
+    RSEQ_NONE,
+)
+from fluidframework_tpu.service.fleet_service import TpuFleetService
+
+from tests.test_fleet_service import _round, make_service
+
+
+def test_wire16_fast_path_matches_verbatim_rows():
+    """Same ops through the packed wire and the int32 fallback must leave
+    identical device state (the packed wire is an encoding, not a
+    different semantics)."""
+    pay = {1: "hello", 2: " world"}
+    texts = {}
+    for force_wide in (False, True):
+        svc = make_service()
+        per_doc = [
+            [E.insert(0, 1, 5), E.insert(5, 2, 6)]
+            for _ in range(svc.n_docs)
+        ]
+        intents, rows = _round(svc, per_doc)
+        if force_wide:
+            # An arg outside int16 forces that FIELD to int32 width —
+            # still the packed wire, wider segment.
+            pay[70000] = "!"
+            rows[0, 1] = E.insert(5, 70000, 1)
+        err, _ = svc.submit_round(intents, rows)
+        assert not err.any()
+        texts[force_wide] = svc.text(0, pay)
+    assert texts[False] == "hello world"
+    assert texts[True] == "hello!"
+
+
+def test_wire32_fallback_on_nonconsecutive_seqs():
+    """A boxcar whose stamps don't follow the consecutive rule (here: a
+    pre-stamped lseq row) must take the verbatim path, counted."""
+    svc = make_service()
+    per_doc = [[E.insert(0, 1, 2)] for _ in range(svc.n_docs)]
+    intents, rows = _round(svc, per_doc)
+    rows[0, 0, 6] = 5  # F_LSEQ nonzero: not a sequenced remote op shape
+    before = svc.wire32_rounds
+    err, _ = svc.submit_round(intents, rows)
+    assert not err.any()
+    assert svc.wire32_rounds == before + 1
+
+
+def test_scribe_int8_overflow_regathers_bucket():
+    """A document whose live seq span exceeds the int8 window must ride
+    the verbatim re-gather — and its summary must still be exact."""
+    svc = make_service(n_docs=4, capacity=64)
+    pay = {i: "x" for i in range(1, 12)}
+    # Round 1: an insert that stays live (no trailing whole-doc remove).
+    err, _ = svc.submit_round(
+        *_round(svc, [[E.insert(0, 1, 1)]] * svc.n_docs)
+    )
+    assert not err.any()
+    n, _ = svc.summarize_dirty(threshold=1)
+    assert n == svc.n_docs
+    # Drive seq far forward with NOOP-free single-op rounds so doc 0
+    # accumulates live rows whose seq values span > 254.
+    for i in range(2, 8):
+        err, _ = svc.submit_round(
+            *_round(svc, [[E.insert(0, i, 1)]] * svc.n_docs)
+        )
+        assert not err.any()
+    # Manufacture a wide span: join a second writer stream whose stamps
+    # advance seq by hundreds while early rows stay live.
+    for i in range(8, 11):
+        rows = [[E.insert(0, i, 1)] for _ in range(svc.n_docs)]
+        intents, r = _round(svc, rows)
+        err, _ = svc.submit_round(intents, r)
+        assert not err.any()
+        svc.fseq.doc_state[:, 0] += 300  # simulate interleaved traffic
+    n, _ = svc.summarize_dirty(threshold=1)
+    assert n == svc.n_docs
+    assert svc.last_summary_breakdown["regathers"] >= 1
+    summary = svc.latest_summary(0)
+    # Every live row must be present with its exact seq (the verbatim
+    # path shipped int32 — no windowing loss).
+    assert summary["count"] >= 9
+    assert min(summary["lanes"]["seq"]) <= 2  # earliest insert still live
+    assert max(summary["lanes"]["seq"]) > 254
+
+
+def test_scribe_lane_regrow_on_concurrent_remove():
+    """After the adaptive set shrinks (no tombstones for 3 sweeps), a
+    removal that populates rseq must re-grow the shipped set — the
+    summary must carry the tombstone, not the pruned default."""
+    svc = make_service(n_docs=2, capacity=64)
+    pay = {1: "abcdef"}
+    err, _ = svc.submit_round(*_round(svc, [[E.insert(0, 1, 6)]] * 2))
+    assert not err.any()
+    # Four sweeps with no tombstones: rseq ages out of the lane set.
+    for i in range(2, 6):
+        svc.summarize_dirty(threshold=1)
+        err, _ = svc.submit_round(
+            *_round(svc, [[E.insert(6 * (i - 1), 1, 6)]] * 2)
+        )
+        assert not err.any()
+    rseq_idx = __import__(
+        "fluidframework_tpu.ops.segment_state", fromlist=["SEGMENT_LANES"]
+    ).SEGMENT_LANES.index("rseq")
+    svc.summarize_dirty(threshold=1)
+    assert rseq_idx not in svc._lane_set
+    # Now a remove with a LAGGING msn (collab window open) so the
+    # tombstone survives compaction into the next sweep.
+    rows = [[E.remove(1, 3)] for _ in range(2)]
+    intents, r = _round(svc, rows)
+    r[:, :, 9] = 0  # F_MSN: hold the window open
+    err, _ = svc.submit_round(intents, r)
+    assert not err.any()
+    n, _ = svc.summarize_dirty(threshold=1)
+    assert n == 2
+    assert rseq_idx in svc._lane_set  # the witness grew the set back
+    summary = svc.latest_summary(0)
+    rseqs = summary["lanes"]["rseq"]
+    assert any(v != RSEQ_NONE for v in rseqs), rseqs
+
+
+def test_pack_blob_one_store_write_per_sweep():
+    """The sweep writes ONE content-addressed pack blob regardless of doc
+    count (the git-packfile analog), and every doc's summary round-trips
+    out of it."""
+    svc = make_service()
+    err, _ = svc.submit_round(
+        *_round(svc, [[E.insert(0, 1, 7)]] * svc.n_docs)
+    )
+    assert not err.any()
+    writes_before = len(svc.store._backend._blobs)
+    n, total = svc.summarize_dirty(threshold=1)
+    assert n == svc.n_docs
+    assert len(svc.store._backend._blobs) == writes_before + 1
+    handles = {svc._summary_handles[d][0] for d in range(svc.n_docs)}
+    assert len(handles) == 1  # every doc points into the same pack
+    for d in range(svc.n_docs):
+        s = svc.latest_summary(d)
+        assert s["count"] == 1 and s["lanes"]["length"][0] == 7
