@@ -43,6 +43,11 @@ DEFAULTS: Dict[str, Any] = {
     "device_max_capacity": 1 << 16,
     "device_sharded_overflow": False,
     "tenants": {},  # tenant id -> shared key (riddler table); {} = open
+    # Out-of-proc durability (service/store_server.py): when store_host
+    # is set, blobs + partition logs live on the external data node and
+    # THIS process becomes disposable (kill/replace semantics).
+    "store_host": "",
+    "store_port": 7071,
 }
 
 
@@ -83,6 +88,18 @@ def build_server(cfg: Dict[str, Any]):
     )
     from fluidframework_tpu.service.pipeline import PipelineFluidService
 
+    log = store = None
+    if cfg["store_host"]:
+        from fluidframework_tpu.service.store_server import (
+            RemoteBlobBackend,
+            RemotePartitionedLog,
+        )
+        from fluidframework_tpu.service.summary_store import SummaryStore
+
+        log = RemotePartitionedLog(cfg["store_host"], cfg["store_port"])
+        store = SummaryStore(
+            backend=RemoteBlobBackend(cfg["store_host"], cfg["store_port"])
+        )
     service = PipelineFluidService(
         n_partitions=cfg["partitions"],
         checkpoint_every=cfg["checkpoint_every"],
@@ -91,6 +108,8 @@ def build_server(cfg: Dict[str, Any]):
         device_capacity=cfg["device_capacity"],
         device_max_capacity=cfg["device_max_capacity"],
         device_sharded_overflow=cfg["device_sharded_overflow"],
+        log=log,
+        store=store,
     )
     tenants = None
     if cfg["tenants"]:

@@ -92,19 +92,45 @@ def batched_em_trunk_scan(doc_ids, L, base_seq, commits: EmCommitBatch,
     )(doc_ids, L, base_seq, commits)
 
 
+@partial(jax.jit, static_argnums=(4,))
+def batched_em_trunk_scan_ring(ring_ids, ring_L, ring_seq,
+                               commits: EmCommitBatch, U: int):
+    """[N, W, Lc] PRE-SEEDED state rings, one per document: newest state
+    (the current trunk) at slot W-1, older retained trunk states
+    leftward, empties seq -1. Seeding lets a commit stream reference
+    states BEHIND the current trunk head — the steady-streaming shape,
+    where each boxcar's early commits were authored against the previous
+    boxcar's tail (a single-state ring forces all of those to the host
+    path; production ingest is a sequence of boxcars, not one giant
+    catch-up)."""
+    return jax.vmap(
+        lambda ri, rl, rs, cb: em_trunk_scan_ring_one(ri, rl, rs, cb, U)
+    )(ring_ids, ring_L, ring_seq, commits)
+
+
 def em_trunk_scan_one(doc_ids, L, base_seq, commits: EmCommitBatch,
                       W: int, U: int):
-    """Single-document EM trunk scan (see module docstring)."""
+    """Single-document EM trunk scan from a single base state (ring
+    seeded with just the current trunk — the one-shot catch-up shape)."""
     Lc = doc_ids.shape[-1]
-    Pc = commits.ins_ids.shape[-1]
-    R = commits.run_start.shape[-1]
-
     # The base state sits at the NEWEST slot: each push rolls left and
     # writes slot W-1, so empties (seq -1) evict first and the base
     # survives W-1 pushes.
     ring_ids = jnp.zeros((W, Lc), jnp.int32).at[W - 1].set(doc_ids)
     ring_L = jnp.zeros(W, jnp.int32).at[W - 1].set(L)
     ring_seq = jnp.full(W, -1, jnp.int32).at[W - 1].set(base_seq)
+    return em_trunk_scan_ring_one(ring_ids, ring_L, ring_seq, commits, U)
+
+
+def em_trunk_scan_ring_one(ring_ids, ring_L, ring_seq,
+                           commits: EmCommitBatch, U: int):
+    """Single-document EM trunk scan (see module docstring). The carry's
+    document state starts as the ring's newest slot."""
+    W, Lc = ring_ids.shape
+    Pc = commits.ins_ids.shape[-1]
+    R = commits.run_start.shape[-1]
+    doc_ids = ring_ids[W - 1]
+    L = ring_L[W - 1]
 
     def step(carry, inp):
         doc_ids, L, ring_ids, ring_L, ring_seq, err = carry

@@ -1,0 +1,116 @@
+"""The k8s deployable renders and holds together (VERDICT r3 do #10).
+
+The reference ships helm charts + raw manifests
+(``server/routerlicious/kubernetes/``, ``server/charts/``); here the
+orchestrated form of the compose deployable lives in ``kubernetes/``.
+These tests parse every manifest and check the cross-references that
+actually break deployments: selector/label agreement, the ConfigMap the
+Deployment mounts exists and carries config the service-layer loader
+accepts, the probed ports are the exposed ports, and the store
+StatefulSet runs a module that exists."""
+
+import glob
+import importlib
+import json
+import os
+
+import yaml
+
+ROOT = os.path.join(os.path.dirname(__file__), "..", "kubernetes")
+
+
+def _docs():
+    out = []
+    for path in sorted(glob.glob(os.path.join(ROOT, "*.yaml"))):
+        with open(path) as f:
+            for doc in yaml.safe_load_all(f):
+                if doc:
+                    out.append((os.path.basename(path), doc))
+    return out
+
+
+def _by_kind(kind):
+    return [d for _p, d in _docs() if d.get("kind") == kind]
+
+
+def test_manifests_parse_and_have_core_kinds():
+    docs = _docs()
+    kinds = {d.get("kind") for _p, d in docs}
+    assert {"Deployment", "Service", "ConfigMap", "StatefulSet"} <= kinds
+    for path, d in docs:
+        assert d.get("apiVersion"), path
+        assert d.get("metadata", {}).get("name"), path
+
+
+def test_service_selector_matches_deployment_labels():
+    deps = {d["metadata"]["name"]: d for d in _by_kind("Deployment")}
+    for svc in _by_kind("Service"):
+        sel = svc["spec"].get("selector")
+        if not sel:
+            continue
+        matched = [
+            d for d in list(deps.values()) + _by_kind("StatefulSet")
+            if all(
+                d["spec"]["template"]["metadata"]["labels"].get(k) == v
+                for k, v in sel.items()
+            )
+        ]
+        assert matched, f"service {svc['metadata']['name']} selects nothing"
+        # The service port must be a containerPort of a matched pod.
+        pod_ports = {
+            p["containerPort"]
+            for d in matched
+            for c in d["spec"]["template"]["spec"]["containers"]
+            for p in c.get("ports", [])
+        }
+        for sp in svc["spec"]["ports"]:
+            assert sp["targetPort"] in pod_ports, svc["metadata"]["name"]
+
+
+def test_deployment_mounts_existing_configmap_with_loadable_config():
+    from fluidframework_tpu.service.server_main import load_config
+
+    cms = {c["metadata"]["name"]: c for c in _by_kind("ConfigMap")}
+    dep = next(
+        d for d in _by_kind("Deployment") if d["metadata"]["name"] == "fluid"
+    )
+    vols = {
+        v["name"]: v for v in dep["spec"]["template"]["spec"]["volumes"]
+    }
+    mounted_cms = [
+        v["configMap"]["name"] for v in vols.values() if "configMap" in v
+    ]
+    assert mounted_cms, "fluid deployment mounts no config"
+    for name in mounted_cms:
+        assert name in cms, f"ConfigMap {name} not in manifests"
+        payload = cms[name]["data"]["config.json"]
+        cfg = json.loads(payload)  # valid JSON
+        # And the service-layer loader accepts every key (tmp file path).
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("w", suffix=".json") as f:
+            f.write(payload)
+            f.flush()
+            loaded = load_config(path=f.name, env={})
+        assert loaded["port"] == cfg["port"]
+
+
+def test_probes_hit_exposed_ports():
+    for d in _by_kind("Deployment") + _by_kind("StatefulSet"):
+        for c in d["spec"]["template"]["spec"]["containers"]:
+            ports = {p["containerPort"] for p in c.get("ports", [])}
+            for probe in ("readinessProbe", "livenessProbe"):
+                if probe in c:
+                    assert c[probe]["tcpSocket"]["port"] in ports, (
+                        d["metadata"]["name"]
+                    )
+
+
+def test_statefulset_command_module_exists():
+    ss = next(
+        d for d in _by_kind("StatefulSet")
+        if d["metadata"]["name"] == "fluid-store"
+    )
+    cmd = ss["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert cmd[:2] == ["python", "-m"]
+    importlib.import_module(cmd[2])  # the module genuinely exists

@@ -1,0 +1,122 @@
+"""Out-of-proc durability: the store node + service replacement
+(VERDICT r3 Missing #2 / do #6).
+
+The reference deployable survives container replacement because
+durability lives in external stores (mongo/kafka/redis). Here the
+equivalent: a :class:`StoreServer` data node holds blobs + partition
+logs over a socket; a PipelineFluidService wired to the remote adapters
+can be killed and REPLACED by a fresh process-equivalent instance, and
+documents survive — the replacement replays the remote logs from zero,
+re-sequences deterministically, and downstream upserts absorb the
+replay."""
+
+import pytest
+
+from fluidframework_tpu.models.shared_map import SharedMap
+from fluidframework_tpu.models.shared_string import SharedString
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service.pipeline import PipelineFluidService
+from fluidframework_tpu.service.store_server import (
+    RemoteBlobBackend,
+    RemotePartitionedLog,
+    StoreServer,
+)
+from fluidframework_tpu.service.summary_store import SummaryStore
+
+
+@pytest.fixture()
+def node():
+    srv = StoreServer(port=0, n_partitions=4).serve_background()
+    yield srv
+    srv.close()
+
+
+def _service(node):
+    return PipelineFluidService(
+        device_backend=False,
+        log=RemotePartitionedLog(node.host, node.port),
+        store=SummaryStore(backend=RemoteBlobBackend(node.host, node.port)),
+    )
+
+
+def drain(runtimes):
+    for _ in range(6):
+        for r in runtimes:
+            r.flush()
+            r.process_incoming()
+
+
+def test_blobs_round_trip_over_the_wire(node):
+    be = RemoteBlobBackend(node.host, node.port)
+    h = be.put_blob(b"hello blob")
+    assert be.has(h) and not be.has("0" * 64)
+    assert be.get_blob(h) == b"hello blob"
+    # Content addressing is preserved across the wire: same bytes, same
+    # handle (incremental summary reuse depends on it).
+    assert be.put_blob(b"hello blob") == h
+
+
+def test_log_round_trips_protocol_objects(node):
+    log = RemotePartitionedLog(node.host, node.port)
+    from fluidframework_tpu.protocol.types import (
+        DocumentMessage,
+        MessageType,
+    )
+
+    msg = DocumentMessage(
+        client_sequence_number=1,
+        reference_sequence_number=0, type=MessageType.OPERATION,
+        contents={"x": 1},
+    )
+    p, off = log.send("rawdeltas", "doc", {"t": "raw", "msg": msg})
+    recs = log.read("rawdeltas", p, 0)
+    assert recs[0].value["msg"] == msg  # dataclass round-trip via codec
+    log.commit("g", "rawdeltas", p, off + 1)
+    assert log.committed("g", "rawdeltas", p) == off + 1
+
+
+def test_service_replacement_documents_survive(node):
+    svc1 = _service(node)
+    a = ContainerRuntime(
+        svc1, "doc", channels=(SharedString("s"), SharedMap("m"))
+    )
+    a.get_channel("s").insert_text(0, "durable ")
+    a.get_channel("m").set("k", 42)
+    drain([a])
+    a.get_channel("s").insert_text(8, "text")
+    drain([a])
+    assert a.get_channel("s").get_text() == "durable text"
+    del svc1, a  # the service container dies
+
+    # A replacement process: fresh in-proc lambda state, same data node.
+    svc2 = _service(node)
+    b = ContainerRuntime(
+        svc2, "doc", channels=(SharedString("s"), SharedMap("m"))
+    )
+    b.process_incoming()
+    assert b.get_channel("s").get_text() == "durable text"
+    assert b.get_channel("m").get("k") == 42
+    # And the replacement keeps serving writes.
+    b.get_channel("s").insert_text(0, "still ")
+    drain([b])
+    assert b.get_channel("s").get_text() == "still durable text"
+
+
+def test_replacement_replay_is_idempotent_downstream(node):
+    """The replacement re-pumps deli from offset zero, RE-PRODUCING the
+    sequenced stream into the shared remote log; scriptorium's by-seq
+    upsert absorbs the duplicates (the at-least-once model crossing a
+    process boundary)."""
+    svc1 = _service(node)
+    a = ContainerRuntime(svc1, "doc", channels=(SharedString("s"),))
+    a.get_channel("s").insert_text(0, "abc")
+    drain([a])
+    seqs1 = sorted(svc1.ops_store["doc"])
+    del svc1, a
+    svc2 = _service(node)
+    b = ContainerRuntime(svc2, "doc", channels=(SharedString("s"),))
+    b.process_incoming()
+    seqs2 = sorted(svc2.ops_store["doc"])
+    assert seqs2[: len(seqs1)] == seqs1  # no gaps, no dup seq keys
+    assert len(seqs2) == len(set(seqs2))
+    assert b.get_channel("s").get_text() == "abc"

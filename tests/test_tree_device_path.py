@@ -141,16 +141,21 @@ def test_concurrent_tail_rides_device_with_em_semantics():
     )
 
 
-def test_window_gate_defers_to_host():
-    """Commits above the collab floor are NOT device-eligible (future
-    commits may rebase into them); they must take the host path."""
+def test_late_rebase_into_device_range_replays_exactly():
+    """Round 3 forbade device ingest above the collab floor because
+    nothing could ever rebase into a device range (no trunk forms). The
+    anchor + replay-log machinery lifts that: the WHOLE run may ride the
+    device, and a late lagging commit that rebases into the
+    device-ingested range reconstructs its author view by scratch replay
+    — byte-exact vs the all-host observer."""
     log = simulate(3, n_commits=12, max_lag=0)
     want = _observer(log).trunk_state
     em = EditManager(session=1)
     em.add_sequenced_batch(list(log), min_seq=log[5].seq)  # floor mid-run
     assert em.trunk_state == want
-    assert em.device_commits <= 6
-    # And the retained window still serves a late concurrent commit.
+    assert em.device_commits == len(log)  # the B-boundary gate is gone
+    # A late concurrent commit refs INTO the device range: the host path
+    # must reconstruct trunk-at-ref from the anchor + device log.
     late = Commit(
         session=900, seq=log[-1].seq + 1, ref=log[7].seq,
         change=M.normalize([M.insert([(999999, "late")])]),
@@ -235,3 +240,81 @@ def test_shared_tree_catchup_rides_device():
     a.process_incoming()
     b.process_incoming()
     assert ta.get() == tb.get()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_cross_document_batch_ingest_parity(seed):
+    """Many documents' runs through ONE vmapped dispatch
+    (``edit_manager.batch_ingest``) must equal the per-document
+    production path on every doc — mixed eligible/concurrent/tiny
+    streams included — and genuinely aggregate into fewer dispatches."""
+    from fluidframework_tpu.tree.edit_manager import batch_ingest
+
+    logs = [
+        simulate(seed * 10 + d, n_commits=18, max_lag=(0 if d % 2 else 6))
+        for d in range(5)
+    ] + [simulate(seed * 10 + 9, n_commits=2)]  # below DEVICE_MIN_BATCH
+    wants = [_observer(log).trunk_state for log in logs]
+    ems = [EditManager(session=1) for _ in logs]
+    stats = batch_ingest(
+        [(em, list(log), log[-1].seq) for em, log in zip(ems, logs)]
+    )
+    for em, want, log in zip(ems, wants, logs):
+        assert em.trunk_state == want
+        assert em.view_state == want
+    assert stats["device_docs"] >= 4  # the eligible docs rode the device
+    assert (
+        stats["device_commits"] + stats["host_commits"]
+        == sum(len(l) for l in logs)
+    )
+    # The whole group's device work was ONE dispatch: every device doc
+    # shows exactly one batch, same group shapes.
+    assert all(em.device_batches <= 1 for em in ems)
+
+
+def test_cross_document_batch_matches_sequential_calls():
+    """batch_ingest(items) must be observationally identical to calling
+    add_sequenced_batch per document (same states, same counters' sums)."""
+    from fluidframework_tpu.tree.edit_manager import batch_ingest
+
+    logs = [simulate(77 + d, n_commits=16, max_lag=3) for d in range(4)]
+    solo = [EditManager(session=1) for _ in logs]
+    for em, log in zip(solo, logs):
+        em.add_sequenced_batch(list(log), min_seq=log[-1].seq)
+    grouped = [EditManager(session=1) for _ in logs]
+    batch_ingest(
+        [(em, list(log), log[-1].seq) for em, log in zip(grouped, logs)]
+    )
+    for a, b in zip(solo, grouped):
+        assert a.trunk_state == b.trunk_state
+        assert a.view_state == b.view_state
+
+
+def test_pipelined_author_survives_device_batch():
+    """A session that pipelines its second commit before seeing its
+    first's ack (normal client behavior) must integrate exactly even
+    when the first commit rode a device batch that cleared the mirrors:
+    ``_make_branch`` rebuilds the pending chain from the retained
+    events. (Round-4 review finding: without the rebuild this crashes
+    in marks.apply or silently diverges.)"""
+    base = simulate(11, n_commits=8, max_lag=0)
+    head = base[-1].seq
+    emA = _observer(base)
+    nid = [50_000]
+    rng = np.random.default_rng(3)
+    c1 = _rand_change(rng, emA.local_view(), 9, nid)
+    # B authors c2 against the SAME view (ref stays at head): a pending
+    # chain — c2's ref precedes its own c1's seq.
+    view_after_c1 = M.apply(emA.local_view(), c1)
+    c2 = _rand_change(rng, view_after_c1, 9, nid)
+    log = base + [
+        Commit(session=900, seq=head + 1, ref=head, change=c1),
+        Commit(session=900, seq=head + 2, ref=head, change=c2),
+    ]
+    want = _observer(log).trunk_state
+    em = EditManager(session=1)
+    em.add_sequenced_batch(list(log), min_seq=0)
+    assert em.trunk_state == want
+    # The base (and possibly c1) rode the device; c2 took the host path
+    # via the session-head gate and the rebuilt mirror.
+    assert em.device_commits >= len(base)

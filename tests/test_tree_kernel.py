@@ -198,12 +198,12 @@ def test_move_bearing_commit_falls_back_to_host_path():
         session=7, seq=3, ref=2,
         change=[("mvout", [(1001, 1)])],
     )
-    assert em._device_prefix(commits, min_seq=5) == 0  # stops before it
+    assert em._device_prefix(commits) == 0  # stops before it
     # The same stream without the foreign mark is device-eligible.
     commits[2] = Commit(
         session=7, seq=3, ref=2, change=[M.insert([(1003, 3)])]
     )
-    assert em._device_prefix(commits, min_seq=5) == 5
+    assert em._device_prefix(commits) == 5
 
 
 def test_compose_pool_overflow_flagged():
