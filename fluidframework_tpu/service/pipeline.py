@@ -250,6 +250,8 @@ class PipelineFluidService:
                    self._broadcaster, self._signals]
         if self._foreman is not None:
             runners.append(self._foreman)
+        if self._moira is not None:
+            runners.append(self._moira)
         for r in runners:
             r.checkpoint()
 

@@ -32,6 +32,7 @@ now crossing a process boundary.
 from __future__ import annotations
 
 import base64
+import os
 import json
 import socket
 import socketserver
@@ -40,6 +41,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from fluidframework_tpu.service.codec import decode_value, encode_value
 from fluidframework_tpu.service.queue import LogRecord, partition_of
+from fluidframework_tpu.utils.lru import LruCache
 from fluidframework_tpu.service.summary_store import SummaryStore
 
 # ---------------------------------------------------------------------------
@@ -92,12 +94,42 @@ class StoreServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  n_partitions: int = 8, directory: Optional[str] = None):
+        if directory:
+            # The native stores mkdir only ONE level; create the tree
+            # here or they silently fall back to memory-only and the
+            # durability contract is fiction.
+            os.makedirs(os.path.join(directory, "plog"), exist_ok=True)
         self.store = SummaryStore(
             native=directory is not None, directory=directory
-        ) if directory else SummaryStore()
+        )
         self.n_partitions = n_partitions
+        # With a directory, the partition logs AND consumer offsets ride
+        # the native disk-backed log (``native/partition_log.cpp``) — a
+        # restarted store node reloads every record and commit, so the
+        # documented replay-from-zero recovery finds the full history.
+        # Without one, plain in-memory dicts (test/single-run mode).
+        self._plog = None
+        if directory:
+            from fluidframework_tpu.utils.native import (
+                NativePartitionLog,
+                native_plog_available,
+            )
+
+            if not native_plog_available():
+                raise RuntimeError(
+                    "disk-backed store node requires libplog.so — a "
+                    "silent in-memory fallback would fake durability"
+                )
+            self._plog = NativePartitionLog(
+                directory + "/plog", n_partitions
+            )
         self._logs: Dict[Tuple[str, int], List[LogRecord]] = {}
         self._commits: Dict[Tuple[str, str, int], int] = {}
+        # Cache tier (the redisCache.ts role): volatile keyed bytes with
+        # LRU eviction, served to historian façades over the same socket.
+        # Deliberately NOT persisted — a restarted cache node serves cold
+        # and read-through refills it (test_historian.py pins this).
+        self._cache = LruCache(64 << 20)
         self._lock = threading.Lock()
         self._tcp = socketserver.ThreadingTCPServer(
             (host, port), _Handler, bind_and_activate=True
@@ -105,6 +137,14 @@ class StoreServer:
         self._tcp.daemon_threads = True
         self._tcp.store_node = self  # type: ignore
         self.host, self.port = self._tcp.server_address[:2]
+
+    @property
+    def cache_capacity(self) -> int:
+        return self._cache.capacity
+
+    @cache_capacity.setter
+    def cache_capacity(self, n: int) -> None:
+        self._cache.capacity = n
 
     # -- request dispatch ------------------------------------------------------
 
@@ -121,14 +161,32 @@ class StoreServer:
             if op == "blob.has":
                 return {"ok": True, "has": self.store.has(head["handle"])}, b""
             if op == "log.send":
+                if self._plog is not None:
+                    p, off = self._plog.send(head["topic"], head["key"], body)
+                    return {"ok": True, "partition": p, "offset": off}, b""
                 p = partition_of(head["key"], self.n_partitions)
                 log = self._logs.setdefault((head["topic"], p), [])
                 rec = LogRecord(offset=len(log), key=head["key"], value=body)
                 log.append(rec)
                 return {"ok": True, "partition": p, "offset": rec.offset}, b""
             if op == "log.read":
-                log = self._logs.get((head["topic"], head["partition"]), [])
                 lo, limit = head["offset"], head.get("limit", 64)
+                if self._plog is not None:
+                    out = []
+                    for off in range(lo, lo + limit):
+                        got = self._plog.read(
+                            head["topic"], head["partition"], off
+                        )
+                        if got is None:
+                            break
+                        key, val = got
+                        out.append({
+                            "offset": off,
+                            "key": key,
+                            "value": base64.b64encode(val).decode(),
+                        })
+                    return {"ok": True, "records": out}, b""
+                log = self._logs.get((head["topic"], head["partition"]), [])
                 recs = log[lo: lo + limit]
                 out = [
                     {
@@ -140,17 +198,44 @@ class StoreServer:
                 ]
                 return {"ok": True, "records": out}, b""
             if op == "log.end":
+                if self._plog is not None:
+                    end = self._plog.end_offset(
+                        head["topic"], head["partition"]
+                    )
+                    return {"ok": True, "end": end}, b""
                 log = self._logs.get((head["topic"], head["partition"]), [])
                 return {"ok": True, "end": len(log)}, b""
             if op == "log.commit":
+                if self._plog is not None:
+                    self._plog.commit(
+                        head["group"], head["topic"], head["partition"],
+                        head["offset"],
+                    )
+                    return {"ok": True}, b""
                 k = (head["group"], head["topic"], head["partition"])
                 self._commits[k] = max(
                     self._commits.get(k, 0), head["offset"]
                 )
                 return {"ok": True}, b""
             if op == "log.committed":
+                if self._plog is not None:
+                    off = self._plog.committed(
+                        head["group"], head["topic"], head["partition"]
+                    )
+                    return {"ok": True, "offset": off}, b""
                 k = (head["group"], head["topic"], head["partition"])
                 return {"ok": True, "offset": self._commits.get(k, 0)}, b""
+            if op == "cache.set":
+                self._cache.set(head["key"], body)
+                return {"ok": True}, b""
+            if op == "cache.get":
+                v = self._cache.get(head["key"])
+                if v is None:
+                    return {"ok": True, "hit": False}, b""
+                return {"ok": True, "hit": True}, v
+            if op == "cache.del":
+                self._cache.delete(head["key"])
+                return {"ok": True}, b""
             if op == "meta":
                 return {"ok": True, "n_partitions": self.n_partitions}, b""
         return {"ok": False, "error": f"unknown op {op}"}, b""

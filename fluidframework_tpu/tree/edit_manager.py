@@ -653,6 +653,13 @@ class EditManager:
             b = self.branches[session]
             if b.base <= min_seq and all(s <= min_seq for s in b.chain_seqs):
                 del self.branches[session]
+        # Session-head entries at or below the floor can never decide the
+        # `ref < last_of` eligibility check again (the sequencer nacks
+        # refs below the collab window) — drop them, or ephemeral-client
+        # churn grows this map forever.
+        for session, head in list(self._session_heads.items()):
+            if head <= min_seq:
+                del self._session_heads[session]
 
 
     # -- internals ------------------------------------------------------------

@@ -12,7 +12,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-from typing import Optional
+from typing import Optional, Tuple
 
 # Native sources/binaries live in the repo's native/ sibling; deployments
 # that install the package elsewhere (e.g. the Dockerfile pip-installs
@@ -139,6 +139,123 @@ class NativeBlobStore:
 
 def native_store_available() -> bool:
     return _load_castore() is not None
+
+
+_plog_registered = False
+
+
+def _load_plog() -> Optional[ctypes.CDLL]:
+    global _plog_registered
+    lib = _load_lib("libplog.so")
+    if lib is None or _plog_registered:
+        return lib
+    _plog_registered = True
+    lib.plog_new.restype = ctypes.c_void_p
+    lib.plog_new.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.plog_free.argtypes = [ctypes.c_void_p]
+    lib.plog_partition.restype = ctypes.c_int
+    lib.plog_partition.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.plog_send.restype = ctypes.c_int64
+    lib.plog_send.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.plog_end_offset.restype = ctypes.c_int64
+    lib.plog_end_offset.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.plog_value_size.restype = ctypes.c_int64
+    lib.plog_value_size.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int64,
+    ]
+    lib.plog_key_size.restype = ctypes.c_int64
+    lib.plog_key_size.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int64,
+    ]
+    lib.plog_read.restype = ctypes.c_int64
+    lib.plog_read.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.plog_commit.restype = ctypes.c_int
+    lib.plog_commit.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_int64,
+    ]
+    lib.plog_committed.restype = ctypes.c_int64
+    lib.plog_committed.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+    ]
+    return lib
+
+
+class NativePartitionLog:
+    """C++ disk-persistent partitioned log + consumer offsets
+    (``native/partition_log.cpp`` — the kafka-broker durability role).
+    Framed appends fflush per record; a restarted process reloads every
+    partition file and the commit table. The CRC32 partitioner matches
+    ``service.queue.partition_of`` exactly (same polynomial), so native
+    and Python routing agree on every key."""
+
+    def __init__(self, directory: Optional[str], n_partitions: int):
+        lib = _load_plog()
+        if lib is None:
+            raise RuntimeError("libplog.so unavailable")
+        self._lib = lib
+        self._h = lib.plog_new(
+            directory.encode() if directory else None, n_partitions
+        )
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.plog_free(self._h)
+            self._h = None
+
+    def send(self, topic: str, key: str, value: bytes) -> Tuple[int, int]:
+        """Append; returns (partition, offset)."""
+        p = self._lib.plog_partition(self._h, key.encode())
+        off = self._lib.plog_send(
+            self._h, topic.encode(), key.encode(), value, len(value)
+        )
+        return int(p), int(off)
+
+    def end_offset(self, topic: str, partition: int) -> int:
+        return int(
+            self._lib.plog_end_offset(self._h, topic.encode(), partition)
+        )
+
+    def read(
+        self, topic: str, partition: int, offset: int
+    ) -> Optional[Tuple[str, bytes]]:
+        t = topic.encode()
+        vn = self._lib.plog_value_size(self._h, t, partition, offset)
+        kn = self._lib.plog_key_size(self._h, t, partition, offset)
+        if vn < 0 or kn < 0:
+            return None
+        kbuf = ctypes.create_string_buffer(max(int(kn), 1))
+        vbuf = ctypes.create_string_buffer(max(int(vn), 1))
+        got = self._lib.plog_read(
+            self._h, t, partition, offset, kbuf, kn, vbuf, vn
+        )
+        assert got == vn, "record changed size mid-read"
+        return kbuf.raw[:kn].decode(), vbuf.raw[:vn]
+
+    def commit(self, group: str, topic: str, partition: int,
+               offset: int) -> None:
+        self._lib.plog_commit(
+            self._h, group.encode(), topic.encode(), partition, offset
+        )
+
+    def committed(self, group: str, topic: str, partition: int) -> int:
+        return int(
+            self._lib.plog_committed(
+                self._h, group.encode(), topic.encode(), partition
+            )
+        )
+
+
+def native_plog_available() -> bool:
+    return _load_plog() is not None
 
 
 _coord_registered = False

@@ -46,3 +46,11 @@ def test_config2b_latency(capsys):
         on_tpu=False,
     )
     assert rec["p99_ms"] > 0
+
+
+def test_config7(capsys):
+    rec = run_json(
+        capsys, B.config7_pipeline_serving, n_docs=12, ops_per_doc=4,
+        rounds=2, socket_docs=2,
+    )
+    assert rec["value"] > 0  # the socket sub-measurement line
