@@ -335,8 +335,12 @@ def from_marks(marks, Lc: int, Pc: int) -> Tuple[DenseChange, int]:
         else:
             from fluidframework_tpu.tree.marks import _check_kind
 
-            _check_kind(t)  # raises: outside the shared mark vocabulary
-            raise AssertionError(f"unlowered mark kind {t!r}")
+            _check_kind(t)  # unknown kinds raise their own error first
+            raise ValueError(
+                f"mark kind {t!r} is outside the dense device IR "
+                "({skip, del, ins}); move-bearing changesets take the "
+                "host path by contract (tree/marks.py)"
+            )
     return DenseChange(del_mask, ins_cnt, ins_ids), i
 
 

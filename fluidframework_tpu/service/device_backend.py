@@ -78,12 +78,19 @@ class DeviceFleetBackend:
         self._buffers: Dict[int, List[np.ndarray]] = {}
         self._buffered_rows = 0
         self._flushes = 0
+        self._scan_token = None  # in-flight async (count, err) pool scan
         self._errored: set = set()  # fleet ids already reported
         self._unreported: List[ChannelKey] = []
         self.ops_applied = 0
-        # Where the last flush's wall went (host staging vs upload +
-        # dispatch) — the bench's attribution source.
+        # Where flush wall goes (host staging vs upload + dispatch):
+        # last_flush_breakdown is the most recent flush; flush_totals
+        # accumulates monotonically (benches diff it across rounds —
+        # flushes fire from inside enqueue when the boxcar fills, so a
+        # last-only view misses most of them).
         self.last_flush_breakdown: Dict[str, float] = {}
+        self.flush_totals: Dict[str, float] = {
+            "staging_s": 0.0, "dispatch_s": 0.0, "staged_rows": 0,
+        }
         # Per-channel ops applied since its last summary readback (the
         # dirtiness signal the device scribe keys on).
         self.ops_since_summary: Dict[ChannelKey, int] = {}
@@ -101,6 +108,14 @@ class DeviceFleetBackend:
             for slots in (1, 2, 4):
                 warm = DocFleet(slots, capacity, max_capacity=max_capacity)
                 warm.apply(np.zeros((slots, 8, OP_WIDTH), np.int32))
+                # The serving path flushes through the SPARSE staging +
+                # the async health scan — warm those too (their first
+                # compile inside a networked drain stalls the server
+                # event loop past client deadlines).
+                warm.apply_sparse(
+                    [0], np.zeros((1, 8, OP_WIDTH), np.int32)
+                )
+                warm.finish_scan(warm.begin_scan())
                 warm.compact()
 
     # -- registry --------------------------------------------------------------
@@ -154,32 +169,49 @@ class DeviceFleetBackend:
 
     def flush(self) -> List[ChannelKey]:
         """Apply every buffered row in batched kernel dispatches; returns
-        channels whose sticky err lane tripped SINCE the last report.
+        channels whose sticky err lane tripped SINCE the last report (one
+        boxcar stale — ``collect_now`` forces a fresh readback).
 
         Staging is GATHERED over busy channels only (``DocFleet.
         apply_sparse``): the host builds ``[B, K]`` for the B channels
         with buffered rows and the device scatters that into the dense
         batch the kernels consume — one busy channel in a 100k-channel
         fleet stages and ships one row, not the fleet (VERDICT r3 Weak
-        #3's O(fleet) boxcar). ``last_flush_breakdown`` records where the
-        wall went (host staging vs upload+dispatch) per flush."""
+        #3's O(fleet) boxcar).
+
+        Health readbacks are ASYNC and one boxcar stale: each dispatch
+        round starts one fused (count, err) pool scan
+        (``DocFleet.begin_scan``) and consumes the PREVIOUS round's —
+        synchronous per-flush count+err pulls were ~80% of pipeline flush
+        wall on the tunneled backend. Soundness: the per-doc chunk limit
+        is HALF the tier headroom, so a promotion trigger read one flush
+        late still fires before the doc can overflow.
+        ``last_flush_breakdown`` / ``flush_totals`` record where the wall
+        went (host staging vs upload+dispatch)."""
         newly_errored: List[ChannelKey] = []
         staging_s = dispatch_s = 0.0
         staged_rows = 0
         while self._buffers:
+            # Consume the PREVIOUS dispatch's health scan before routing
+            # this round: promotion (tier moves, sharded-overflow
+            # eviction) changes where a doc's rows must go.
+            if self._scan_token is not None:
+                scans = self.fleet.finish_scan(self._scan_token)
+                self._scan_token = None
+                self._consume_scan(scans, newly_errored)
             take: Dict[int, List[np.ndarray]] = {}
             rest: Dict[int, List[np.ndarray]] = {}
             for idx, rows in self._buffers.items():
-                # Fleet docs chunk to their tier's promotion headroom: a
-                # burst must not cross high_water AND overflow in one
-                # dispatch — growth promotes tier-by-tier between rounds
-                # (fleet.py's stated capacity contract).
+                # Fleet docs chunk to HALF their tier's promotion
+                # headroom: the promotion trigger is one boxcar stale, so
+                # two flushes of growth must fit between high_water and
+                # capacity (fleet.py's stated contract).
                 limit = self.max_batch
                 if idx not in self._sharded:
                     cap = self.fleet.placement[idx][0]
                     limit = min(
                         limit,
-                        max(1, int((1 - self.fleet.high_water) * cap)),
+                        max(1, int((1 - self.fleet.high_water) * cap / 2)),
                     )
                 take[idx] = rows[:limit]
                 if len(rows) > limit:
@@ -213,9 +245,7 @@ class DeviceFleetBackend:
                 staging_s += (t1 - t0) + self.fleet.last_routing_s
                 dispatch_s += (t2 - t1) - self.fleet.last_routing_s
                 staged_rows += ops_b.shape[0] * k
-                self.fleet.check_and_migrate()
-                if self.sharded_overflow:
-                    self._promote_overflow()
+                self._scan_token = self.fleet.begin_scan()
             self._flushes += 1
             compact_now = self._flushes % self.compact_every == 0
             for idx, rows in sharded_rows.items():
@@ -232,15 +262,46 @@ class DeviceFleetBackend:
                 doc.rebalance()  # self-compacts when it triggers
             if compact_now:
                 self.fleet.compact()
-            newly_errored.extend(self._collect_errors())
         self._buffered_rows = 0
         self.last_flush_breakdown = {
             "staging_s": staging_s,
             "dispatch_s": dispatch_s,
             "staged_rows": staged_rows,
         }
+        self.flush_totals["staging_s"] += staging_s
+        self.flush_totals["dispatch_s"] += dispatch_s
+        self.flush_totals["staged_rows"] += staged_rows
         self._unreported.extend(newly_errored)
         return newly_errored
+
+    def _consume_scan(
+        self, scans: Dict[int, np.ndarray],
+        newly_errored: List[ChannelKey],
+    ) -> None:
+        """Run the health consequences of one (count, err) pool scan:
+        tier promotion, sharded-overflow promotion, and sticky-err
+        collection."""
+        counts = {cap: s[0] for cap, s in scans.items()}
+        errs = {cap: s[1] for cap, s in scans.items()}
+        self.fleet.check_and_migrate(counts)
+        if self.sharded_overflow:
+            self._promote_overflow()
+        newly_errored.extend(self._collect_errors(errs))
+
+    def collect_now(self) -> List[ChannelKey]:
+        """Barrier the in-flight health scan (the explicit flush_device
+        contract: errors reflect every dispatched boxcar). ``flush()``
+        begins its scan AFTER the final dispatch, so finishing that token
+        covers everything applied — no fresh scan needed, just the wait
+        on an already-streaming copy."""
+        if self._scan_token is None:
+            return []
+        scans = self.fleet.finish_scan(self._scan_token)
+        self._scan_token = None
+        newly: List[ChannelKey] = []
+        self._consume_scan(scans, newly)
+        self._unreported.extend(newly)
+        return newly
 
     def _promote_overflow(self) -> None:
         """Re-home docs that outgrew the top fleet tier into ShardedDocs
@@ -267,10 +328,18 @@ class DeviceFleetBackend:
             doc.load_single(state)
             self._sharded[idx] = doc
 
-    def _collect_errors(self) -> List[ChannelKey]:
+    def _collect_errors(
+        self, errs: Optional[Dict[int, np.ndarray]] = None
+    ) -> List[ChannelKey]:
         out: List[ChannelKey] = []
-        for pool in self.fleet.pools.values():
-            err = np.asarray(pool.state.err)
+        for cap, pool in self.fleet.pools.items():
+            err = errs.get(cap) if errs is not None else None
+            if err is None:
+                err = np.asarray(pool.state.err)
+            if len(err) < pool.n_slots:
+                err = np.concatenate(
+                    [err, np.zeros(pool.n_slots - len(err), np.int32)]
+                )
             live = pool.live_slots()
             for slot in live[err[live] != 0]:
                 idx = int(pool.doc_of_slot[slot])

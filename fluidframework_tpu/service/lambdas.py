@@ -67,12 +67,26 @@ class PartitionLambda:
 
 class CheckpointStore:
     """Durable (in this harness: in-memory, survives lambda restarts)
-    checkpoint documents — the Mongo IDeliState/IScribe analog."""
+    checkpoint documents — the Mongo IDeliState/IScribe analog.
+
+    ``merge=True`` saves are INCREMENTAL: the given state is a partial
+    per-document dict merged into the stored one (the reference
+    checkpoints dirty document state, not the whole partition —
+    ``deli/checkpointManager.ts``; serializing every doc every
+    checkpoint is quadratic at fleet scale)."""
 
     def __init__(self) -> None:
         self._data: Dict[Tuple[str, int], dict] = {}
 
-    def save(self, group: str, partition: int, offset: int, state: Any) -> None:
+    def save(self, group: str, partition: int, offset: int, state: Any,
+             merge: bool = False) -> None:
+        if merge:
+            ent = self._data.setdefault(
+                (group, partition), {"offset": 0, "state": {}}
+            )
+            ent["offset"] = offset
+            ent["state"].update(copy.deepcopy(state))
+            return
         self._data[(group, partition)] = {
             "offset": offset,
             "state": copy.deepcopy(state),
@@ -88,9 +102,16 @@ class DocumentLambda(PartitionLambda):
     document-router): every record's key is its document id; each document
     gets its own lambda instance and strictly-ordered substream."""
 
+    # state() returns only documents touched since the last call; the
+    # checkpoint store merges them (dirty-doc checkpointing — without it
+    # every checkpoint serializes the whole partition's documents, which
+    # is quadratic in fleet size on the serving path).
+    incremental_state = True
+
     def __init__(self, per_doc_factory: Callable[[str, Any], PartitionLambda]):
         self._factory = per_doc_factory
         self._docs: Dict[str, PartitionLambda] = {}
+        self._dirty: set = set()
 
     def doc(self, doc_id: str) -> PartitionLambda:
         if doc_id not in self._docs:
@@ -98,10 +119,16 @@ class DocumentLambda(PartitionLambda):
         return self._docs[doc_id]
 
     def handler(self, key: str, value: Any) -> List[Tuple[str, str, Any]]:
+        self._dirty.add(key)
         return self.doc(key).handler(key, value)
 
     def state(self) -> Any:
-        return {doc_id: lam.state() for doc_id, lam in self._docs.items()}
+        dirty, self._dirty = self._dirty, set()
+        return {
+            doc_id: self._docs[doc_id].state()
+            for doc_id in dirty
+            if doc_id in self._docs
+        }
 
     def restore_docs(self, state: Dict[str, Any]) -> None:
         for doc_id, doc_state in (state or {}).items():
@@ -161,8 +188,10 @@ class PartitionRunner:
     def checkpoint(self, partition: Optional[int] = None) -> None:
         parts = range(self.log.n_partitions) if partition is None else [partition]
         for p in parts:
+            lam = self._lambdas[p]
             self.checkpoints.save(
-                self.group, p, self._offsets[p], self._lambdas[p].state()
+                self.group, p, self._offsets[p], lam.state(),
+                merge=getattr(lam, "incremental_state", False),
             )
             self.log.commit(self.group, self.topic, p, self._offsets[p])
             self._since_checkpoint[p] = 0

@@ -32,6 +32,7 @@ import hmac
 import json
 import secrets
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
@@ -426,6 +427,35 @@ class FluidNetworkServer:
     def _drain_all(self) -> None:
         """Forward anything the service put in per-connection queues since
         the last drain (the broadcaster role at the socket layer)."""
+        # Time-based device boxcar: a service with a raised
+        # device_flush_min_rows defers sub-threshold rows so each client
+        # submit doesn't pay a device dispatch; this idle flush bounds
+        # how long they wait (and how late capacity nacks can be). The
+        # flush is the ASYNC form (dispatch enqueue + streaming health
+        # scan, no round-trip barrier — blocking the event loop on the
+        # device RTT every tick starves socket IO); the barrier
+        # (collect_now) runs only once the ingest goes quiet, so sticky
+        # errors still surface within a tick of the last boxcar.
+        dev = getattr(self.service, "device", None)
+        if dev is not None:
+            now = time.monotonic()
+            last = getattr(self, "_last_dev_flush", 0.0)
+            if dev._buffered_rows and now - last > 0.05:
+                self._last_dev_flush = now
+                dev.flush()
+                nack = getattr(self.service, "_nack_device_errors", None)
+                if nack is not None:
+                    nack()
+            elif (
+                not dev._buffered_rows
+                and dev._scan_token is not None
+                and now - last > 0.1
+            ):
+                self._last_dev_flush = now
+                dev.collect_now()
+                nack = getattr(self.service, "_nack_device_errors", None)
+                if nack is not None:
+                    nack()
         for s in self._sessions:
             if s.push_doc is not None:
                 # Push delivery: stream newly sequenced ops straight from

@@ -91,6 +91,8 @@ class PipelineFluidService:
         device_capacity: int = 128,
         device_max_capacity: int = 1 << 16,
         device_sharded_overflow: bool = False,
+        device_max_batch: int = 512,
+        device_flush_min_rows: int = 1,
         foreman_tasks: tuple = ("summarizer",),
         index_sink: Optional[Any] = None,
         log: Optional[Any] = None,
@@ -168,14 +170,21 @@ class PipelineFluidService:
         # service/device_lambda.py).
         self.device: Optional[Any] = None
         self._device_runner: Optional[PartitionRunner] = None
+        # Pump-quiescence auto-flush threshold: 1 = flush every pump (the
+        # in-proc test semantics); a serving front door raises it so each
+        # client submit doesn't pay a device boxcar — sub-threshold rows
+        # ride the next read/explicit flush or the network server's
+        # time-based idle flush (network_server._drain_all).
+        self.device_flush_min_rows = device_flush_min_rows
         if device_backend:
             self._make_device(
                 device_capacity, device_max_capacity,
-                device_sharded_overflow,
+                device_sharded_overflow, device_max_batch,
             )
 
     def _make_device(
-        self, capacity: int, max_capacity: int, sharded_overflow: bool
+        self, capacity: int, max_capacity: int, sharded_overflow: bool,
+        max_batch: int = 512,
     ) -> None:
         from fluidframework_tpu.service.device_backend import (
             DeviceFleetBackend,
@@ -184,9 +193,11 @@ class PipelineFluidService:
 
         self.device = DeviceFleetBackend(
             capacity=capacity, max_capacity=max_capacity,
-            sharded_overflow=sharded_overflow,
+            sharded_overflow=sharded_overflow, max_batch=max_batch,
         )
-        self._device_capacity = (capacity, max_capacity, sharded_overflow)
+        self._device_capacity = (
+            capacity, max_capacity, sharded_overflow, max_batch,
+        )
 
         def factory(p: int, state):
             return DocumentLambda(
@@ -286,12 +297,18 @@ class PipelineFluidService:
             total += n
             if n == 0:
                 # Quiescent: boxcar any freshly buffered device rows and
-                # surface err-lane feedback now — nacks must reach clients
-                # on the ingestion path, not only when someone reads.
+                # surface err-lane feedback — nacks reach clients on the
+                # ingestion path. The auto-flush here skips the health-
+                # scan barrier (collect_now): the scan streams back
+                # asynchronously and its errors surface within one more
+                # pump — a per-pump synchronous readback would put the
+                # device round-trip latency on EVERY front-door submit.
                 if self.device is not None and (
-                    self.device._buffered_rows or self.device._unreported
+                    self.device._buffered_rows >= self.device_flush_min_rows
+                    or self.device._unreported
                 ):
-                    self.flush_device()
+                    self.device.flush()
+                    self._nack_device_errors()
                 return total
 
     # -- the device serving surface -------------------------------------------
@@ -304,6 +321,13 @@ class PipelineFluidService:
         if self.device is None:
             return
         self.device.flush()
+        # Barrier the async health scan: nacks must reflect THIS flush,
+        # not the previous boxcar's (the serving loop's intra-flush scans
+        # are deliberately one boxcar stale).
+        self.device.collect_now()
+        self._nack_device_errors()
+
+    def _nack_device_errors(self) -> None:
         for doc_id, address in self.device.take_errors():
             Lumberjack.new_metric(
                 LumberEventName.DeviceCapacity,

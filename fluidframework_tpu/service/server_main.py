@@ -42,6 +42,10 @@ DEFAULTS: Dict[str, Any] = {
     "device_capacity": 128,
     "device_max_capacity": 1 << 16,
     "device_sharded_overflow": False,
+    # Deployed front doors boxcar device flushes (sub-threshold rows ride
+    # the server's 50ms idle flush) — per-submit flushes put a device
+    # dispatch on every client op.
+    "device_flush_min_rows": 64,
     "tenants": {},  # tenant id -> shared key (riddler table); {} = open
     # Out-of-proc durability (service/store_server.py): when store_host
     # is set, blobs + partition logs live on the external data node and
@@ -108,6 +112,7 @@ def build_server(cfg: Dict[str, Any]):
         device_capacity=cfg["device_capacity"],
         device_max_capacity=cfg["device_max_capacity"],
         device_sharded_overflow=cfg["device_sharded_overflow"],
+        device_flush_min_rows=cfg["device_flush_min_rows"],
         log=log,
         store=store,
     )

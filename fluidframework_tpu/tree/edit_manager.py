@@ -288,12 +288,14 @@ class EditManager:
                 break
             if c.ref < retained[0]:
                 break  # ring would have evicted the ref state
-            if any(t not in M.MARK_KINDS for t, _v in c.change):
-                # Mark kinds beyond the dense IR (the reference sequence-
-                # field also has MoveOut/MoveIn/Revive, format.ts:14-220;
-                # here moves ride the hierarchical identity layer and
-                # revive is value-carrying delete inversion) fall back to
-                # the host path BY CONTRACT — never silently miscompiled.
+            if any(t not in M.DEVICE_MARK_KINDS for t, _v in c.change):
+                # Mark kinds beyond the dense IR — move-bearing changesets
+                # (mout/min, the reference's MoveOut/MoveIn,
+                # format.ts:14-220) — fall back to the host algebra BY
+                # CONTRACT, never silently miscompiled; the host rebase/
+                # compose handle them (tree/marks.py capture/splice) and
+                # the device share under a move-bearing workload is a
+                # measured number (bench config 3c move mix).
                 break
             n_ins = sum(len(v) for t, v in c.change if t == "ins")
             n_runs = sum(1 for t, _v in c.change if t == "ins")
@@ -755,13 +757,18 @@ class EditManager:
 
     def _transport(self, commit: Commit, pre: List[Cell]) -> TrunkCommit:
         """Decode a commit authored on view ``pre`` into id-operations and
-        its positional trunk form (the id-anchor transport)."""
+        its positional trunk form (the id-anchor transport). Move marks
+        lower to detach + re-attach of the SAME cell ids
+        (``marks.lower_moves``): the id algebra anchors by cell identity,
+        so a moved run re-anchors at its destination exactly like an
+        insert of those ids — convergent by the same argument."""
         post = M.apply(pre, commit.change)
+        change = M.lower_moves(commit.change)
 
         deleted_ids: Set[int] = set()
         raw_runs: List[Tuple[int, List[Cell]]] = []  # (start in post, cells)
         i_out = 0
-        for t, v in commit.change:
+        for t, v in change:
             if t == "skip":
                 i_out += v
             elif t == "del":
@@ -808,15 +815,45 @@ class EditManager:
 def _diff_cells(
     old: List[Cell], new: List[Cell], deleted_ids: Set[int]
 ) -> M.Changeset:
-    """Positional changeset old -> new (new = old minus deletions plus
-    inserted runs of ids not present in old)."""
-    old_ids = {c[0] for c in old}
+    """Positional changeset old -> new. Cells present in both keep their
+    identity: the longest increasing subsequence of shared ids (by old
+    position, in new order) stays as skips; every other shared cell —
+    REORDERED content, i.e. a move — expresses as delete at its old spot
+    + re-insert of the same id at its new spot (the lowered move form the
+    id-anchor transport and resubmission squash both consume). Ids only
+    in old delete; ids only in new insert."""
+    old_pos = {c[0]: k for k, c in enumerate(old)}
+    shared = [
+        (old_pos[c[0]], c[0]) for c in new
+        if c[0] in old_pos and c[0] not in deleted_ids
+    ]
+    # Patience LIS over old positions (in new order): the maximal set of
+    # shared cells whose relative order is unchanged.
+    import bisect
+
+    tails: List[int] = []  # tails[k] = smallest ending old-pos of len-k+1
+    tail_ids: List[int] = []
+    prev: Dict[int, Optional[int]] = {}
+    for pos, cid in shared:
+        k = bisect.bisect_left(tails, pos)
+        prev[cid] = tail_ids[k - 1] if k else None
+        if k == len(tails):
+            tails.append(pos)
+            tail_ids.append(cid)
+        else:
+            tails[k] = pos
+            tail_ids[k] = cid
+    kept: Set[int] = set()
+    cur: Optional[int] = tail_ids[-1] if tail_ids else None
+    while cur is not None:
+        kept.add(cur)
+        cur = prev[cur]
+
     change: M.Changeset = []
     oi = 0
     for cell in new:
-        if cell[0] in old_ids:
+        if cell[0] in kept:
             while oi < len(old) and old[oi][0] != cell[0]:
-                assert old[oi][0] in deleted_ids, "cell reorder in diff"
                 change.append(M.delete([old[oi]]))
                 oi += 1
             change.append(M.skip(1))

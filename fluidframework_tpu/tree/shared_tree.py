@@ -29,6 +29,19 @@ from fluidframework_tpu.tree.edit_manager import Commit, EditManager
 _ID_STRIDE = 1 << 14
 
 
+def _decode_mark(t: str, v) -> tuple:
+    """Wire form -> mark tuples (cells arrive as JSON lists)."""
+    if t == "skip":
+        return (t, v)
+    if t in ("del", "ins"):
+        return (t, [tuple(c) for c in v])
+    if t == "mout":
+        return (t, (v[0], v[1], [tuple(c) for c in v[2]]))
+    if t == "min":
+        return (t, (v[0], v[1], v[2]))
+    raise ValueError(f"unknown wire mark kind {t!r}")
+
+
 class SharedTree(SharedObject):
     def __init__(self, channel_id: str):
         super().__init__(channel_id)
@@ -109,15 +122,40 @@ class SharedTree(SharedObject):
         assert 0 <= index and index + count <= len(view)
         self._author([M.skip(index), M.delete(view[index : index + count])])
 
+    def move_nodes(self, index: int, count: int, dest: int) -> None:
+        """Move ``view[index:index+count]`` so it lands at position
+        ``dest`` of the post-detach sequence — a first-class move
+        changeset (mout/min marks, the reference sequence-field MoveOut/
+        MoveIn, ``format.ts:14-220``), NOT a delete + fresh insert: cell
+        ids are preserved, so concurrent edits anchored to the moved
+        cells follow them."""
+        self._drain()
+        view = self._em.local_view()
+        assert 0 <= index and index + count <= len(view)
+        assert 0 <= dest <= len(view) - count, (
+            f"move dest {dest} out of range for the post-detach sequence"
+        )
+        cells = view[index : index + count]
+        if dest == index:
+            return
+        if dest < index:
+            change = [
+                M.skip(dest), M.move_in(0, count),
+                M.skip(index - dest), M.move_out(0, cells),
+            ]
+        else:
+            change = [
+                M.skip(index), M.move_out(0, cells),
+                M.skip(dest - index), M.move_in(0, count),
+            ]
+        self._author(change)
+
     # -- sequenced stream -----------------------------------------------------
 
     def process_core(
         self, msg: SequencedDocumentMessage, local: bool, local_metadata: Optional[Any]
     ) -> None:
-        marks = [
-            (t, v if t == "skip" else [tuple(c) for c in v])
-            for t, v in msg.contents["marks"]
-        ]
+        marks = [_decode_mark(t, v) for t, v in msg.contents["marks"]]
         commit = Commit(
             session=msg.client_id,
             seq=msg.sequence_number,

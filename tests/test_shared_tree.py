@@ -137,3 +137,129 @@ def test_tree_reconnect():
     a.reconnect()
     drain([a, b])
     assert ta.get() == tb.get() == [99, 2, 3, 4]
+
+
+# ---------------------------------------------------------------------------
+# First-class moves on the DDS surface (move_nodes -> mout/min marks).
+
+
+def test_move_nodes_basic():
+    svc, (a, b) = setup()
+    ta, tb = a.get_channel("t"), b.get_channel("t")
+    ta.insert_nodes(0, [1, 2, 3, 4, 5])
+    drain([a, b])
+    ta.move_nodes(1, 2, 3)  # [2,3] to the end
+    drain([a, b])
+    assert ta.get() == tb.get() == [1, 4, 5, 2, 3]
+    tb.move_nodes(3, 2, 0)  # and back to the front
+    drain([a, b])
+    assert ta.get() == tb.get() == [2, 3, 1, 4, 5]
+
+
+def test_concurrent_move_and_delete_converge():
+    """One client moves a run; the other deletes part of it. Deletion
+    wins over movement regardless of sequencing order."""
+    svc, (a, b) = setup()
+    ta, tb = a.get_channel("t"), b.get_channel("t")
+    ta.insert_nodes(0, [1, 2, 3, 4])
+    drain([a, b])
+    ta.move_nodes(1, 2, 2)  # [2,3] toward the end
+    tb.delete_nodes(2, 1)  # delete 3
+    a.flush()
+    b.flush()
+    drain([a, b])
+    assert ta.get() == tb.get()
+    assert 3 not in ta.get() and 2 in ta.get()
+
+
+def test_concurrent_move_and_insert_converge():
+    svc, (a, b) = setup()
+    ta, tb = a.get_channel("t"), b.get_channel("t")
+    ta.insert_nodes(0, [1, 2, 3, 4])
+    drain([a, b])
+    ta.move_nodes(0, 2, 2)  # [1,2] to the end
+    tb.insert_nodes(4, [9])  # append
+    a.flush()
+    b.flush()
+    drain([a, b])
+    assert ta.get() == tb.get()
+    assert set(ta.get()) == {1, 2, 3, 4, 9}
+
+
+def test_concurrent_moves_of_same_content_converge():
+    svc, (a, b) = setup()
+    ta, tb = a.get_channel("t"), b.get_channel("t")
+    ta.insert_nodes(0, [1, 2, 3, 4, 5])
+    drain([a, b])
+    ta.move_nodes(1, 2, 3)  # [2,3] right
+    tb.move_nodes(1, 2, 0)  # [2,3] to the front
+    a.flush()
+    b.flush()
+    drain([a, b])
+    assert ta.get() == tb.get()
+    assert sorted(ta.get()) == [1, 2, 3, 4, 5]
+
+
+def test_move_commits_fall_back_to_host_by_contract():
+    """Move-bearing commits are outside the dense device IR: the EM gate
+    must route them host-side (counters prove it) while plain commits
+    around them still converge."""
+    svc, (a, b) = setup()
+    ta, tb = a.get_channel("t"), b.get_channel("t")
+    ta.insert_nodes(0, list(range(8)))
+    drain([a, b])
+    ta.move_nodes(0, 2, 4)
+    drain([a, b])
+    ta.insert_nodes(0, [100])
+    drain([a, b])
+    assert ta.get() == tb.get()
+    stats = tb.ingest_stats
+    assert stats["host_commits"] >= 1  # the move rode the host path
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_move_farm(seed):
+    """Randomized multi-client convergence with moves in the mix."""
+    rng = np.random.default_rng(seed + 600)
+    svc, rts = setup(3)
+    trees = [rt.get_channel("t") for rt in rts]
+    trees[0].insert_nodes(0, list(range(10)))
+    drain(rts)
+    for _round in range(6):
+        for k, t in enumerate(trees):
+            r = rng.random()
+            n = len(t.get())
+            if r < 0.4 and n >= 2:
+                i = int(rng.integers(0, n - 1))
+                cnt = int(rng.integers(1, min(3, n - i) + 1))
+                dest = int(rng.integers(0, n - cnt + 1))
+                t.move_nodes(i, cnt, dest)
+            elif r < 0.7:
+                t.insert_nodes(
+                    int(rng.integers(0, n + 1)),
+                    [1000 * (seed + 1) + _round * 10 + k],
+                )
+            elif n:
+                t.delete_nodes(int(rng.integers(0, n)), 1)
+        for rt in rts:
+            rt.flush()
+        drain(rts)
+        got = [t.get() for t in trees]
+        assert got[0] == got[1] == got[2], (_round, got)
+
+
+def test_move_survives_reconnect_resubmission():
+    """A pending local move squashes through resubmission (the LIS diff
+    expresses the reorder as same-id detach+reattach) and converges."""
+    svc, (a, b) = setup()
+    ta, tb = a.get_channel("t"), b.get_channel("t")
+    ta.insert_nodes(0, [1, 2, 3, 4])
+    drain([a, b])
+    a.disconnect()
+    ta.move_nodes(0, 2, 2)  # pending while offline: [1,2] to the end
+    tb.insert_nodes(4, [9])
+    drain([b])
+    a.reconnect()
+    drain([a, b])
+    assert ta.get() == tb.get()
+    assert set(ta.get()) == {1, 2, 3, 4, 9}

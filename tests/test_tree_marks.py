@@ -132,3 +132,153 @@ def test_rebase_insert_inside_deleted_range_slides():
     c = [M.skip(2), M.insert([9])]  # insert between 2 and 3
     out = M.apply(M.apply(s, o), M.rebase(c, o))
     assert out == [1, 9, 4]
+
+
+# ---------------------------------------------------------------------------
+# Moves (mout/min — the reference sequence-field MoveOut/MoveIn,
+# format.ts:14-220; capture/splice semantics per moveEffectTable.ts).
+
+
+def random_change_with_moves(rng, state):
+    """A valid changeset over `state` mixing all five mark kinds."""
+    out = []
+    i = 0
+    mid = 0
+    pending = []  # (mid, count) move-ins yet to be placed
+    while i < len(state):
+        r = rng.random()
+        run = int(rng.integers(1, 4))
+        run = min(run, len(state) - i)
+        if pending and rng.random() < 0.35:
+            m, n = pending.pop()
+            out.append(M.move_in(m, n))
+            continue
+        if r < 0.3:
+            out.append(M.skip(run))
+            i += run
+        elif r < 0.55:
+            out.append(M.delete(state[i : i + run]))
+            i += run
+        elif r < 0.75:
+            out.append(M.insert(random_state(rng, int(rng.integers(1, 3)))))
+        else:
+            out.append(M.move_out(mid, state[i : i + run]))
+            pending.append((mid, run))
+            mid += 1
+            i += run
+    for m, n in pending:
+        out.append(M.move_in(m, n))
+    if rng.random() < 0.5:
+        out.append(M.insert(random_state(rng, int(rng.integers(1, 3)))))
+    return M.normalize(out)
+
+
+def test_move_apply_and_invert_directed():
+    s = [1, 2, 3, 4, 5]
+    c = [M.skip(1), M.move_out(0, [2, 3]), M.skip(2), M.move_in(0, 2)]
+    assert M.apply(s, c) == [1, 4, 5, 2, 3]
+    assert M.apply(M.apply(s, c), M.invert(c)) == s
+    # Move left: the attach precedes the detach in mark order.
+    c2 = [M.move_in(7, 2), M.skip(3), M.move_out(7, [4, 5])]
+    assert M.apply(s, c2) == [4, 5, 1, 2, 3]
+    assert M.apply(M.apply(s, c2), M.invert(c2)) == s
+
+
+def test_compose_delete_of_moved_content_dies_at_source():
+    s = [1, 2, 3, 4, 5]
+    move = [M.skip(1), M.move_out(0, [2, 3]), M.skip(2), M.move_in(0, 2)]
+    kill = [M.skip(3), M.delete([2, 3])]
+    assert M.apply(s, M.compose(move, kill)) == [1, 4, 5]
+
+
+def test_compose_chained_moves():
+    s = [1, 2, 3, 4, 5]
+    move = [M.skip(1), M.move_out(0, [2, 3]), M.skip(2), M.move_in(0, 2)]
+    again = [M.move_in(1, 2), M.skip(3), M.move_out(1, [2, 3])]
+    assert M.apply(s, M.compose(move, again)) == [2, 3, 1, 4, 5]
+
+
+def test_rebase_marks_follow_moved_content():
+    """c deletes content that over moved: the delete follows the content
+    to its destination (moveEffectTable semantics)."""
+    s = [1, 2, 3, 4, 5]
+    over = [M.skip(1), M.move_out(0, [2, 3]), M.skip(2), M.move_in(0, 2)]
+    c = [M.skip(1), M.delete([2, 3])]
+    assert M.apply(M.apply(s, over), M.rebase(c, over)) == [1, 4, 5]
+
+
+def test_rebase_both_move_later_wins():
+    """Both sides move the same unit: the later-sequenced move wins in
+    either application order."""
+    s = [1, 2, 3]
+    a = [M.move_in(0, 1), M.skip(2), M.move_out(0, [3])]  # 3 to front
+    b = [M.skip(2), M.move_out(0, [3]), M.move_in(0, 1)]  # 3 stays-ish
+    via_a = M.apply(M.apply(s, a), M.rebase(b, a))
+    via_b = M.apply(M.apply(s, b), M.rebase(a, b, c_after=True))
+    assert via_a == via_b
+
+
+def test_attach_stays_at_source_when_region_moves():
+    """An insert positioned inside a region that over moved anchors at
+    the source boundary (attaches do not follow moves)."""
+    s = [1, 2, 3, 4]
+    over = [M.skip(1), M.move_out(0, [2, 3]), M.skip(1), M.move_in(0, 2)]
+    c = [M.skip(2), M.insert([9])]  # between 2 and 3
+    out = M.apply(M.apply(s, over), M.rebase(c, over))
+    assert out == [1, 9, 4, 2, 3]
+
+
+def test_lower_moves_preserves_apply():
+    rng = np.random.default_rng(11)
+    for seed in range(20):
+        rng = np.random.default_rng(seed + 7000)
+        s = random_state(rng)
+        c = random_change_with_moves(rng, s)
+        lowered = M.lower_moves(c)
+        assert not M.has_moves(lowered)
+        assert M.apply(s, lowered) == M.apply(s, c)
+
+
+@pytest.mark.parametrize("seed", range(60))
+def test_move_laws_fuzz(seed):
+    """All four algebra laws over move-bearing changesets."""
+    rng = np.random.default_rng(seed + 12000)
+    s = random_state(rng)
+    a = random_change_with_moves(rng, s)
+    out = M.apply(s, a)
+    # invert round trip
+    assert M.apply(out, M.invert(a)) == s
+    # compose == sequential apply
+    b = random_change_with_moves(rng, out)
+    assert M.apply(s, M.compose(a, b)) == M.apply(out, b)
+    # associativity
+    s2 = M.apply(out, b)
+    c = random_change_with_moves(rng, s2)
+    left = M.compose(M.compose(a, b), c)
+    right = M.compose(a, M.compose(b, c))
+    assert M.apply(s, left) == M.apply(s, right)
+    # pairwise rebase convergence
+    b2 = random_change_with_moves(rng, s)
+    via_a = M.apply(M.apply(s, a), M.rebase(b2, a))
+    via_b = M.apply(M.apply(s, b2), M.rebase(a, b2, c_after=True))
+    assert via_a == via_b
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_unit_engine_matches_run_engine_move_free(seed):
+    """The unit-level canonical engine (the move path) must agree with
+    the run-based co-iteration on move-free inputs — each implementation
+    checks the other."""
+    rng = np.random.default_rng(seed + 13000)
+    s = random_state(rng)
+    a = random_change(rng, s)
+    o = M.apply(s, a)
+    b = random_change(rng, o)
+    assert M.apply(s, M._compose_units(a, b)) == M.apply(
+        s, M._compose_runs(a, b)
+    )
+    c = random_change(rng, s)
+    for c_after in (False, True):
+        assert M.apply(
+            M.apply(s, a), M._rebase_units(c, a, c_after)
+        ) == M.apply(M.apply(s, a), M._rebase_runs(c, a, c_after))
