@@ -148,6 +148,9 @@ class NetworkConnection:
                     "token": token,
                     "mode": mode,
                     "from_seq": from_seq,
+                    # Negotiate the batched binary frame wire (both
+                    # directions); frame-ignorant servers drop the key.
+                    "frames": True,
                 }
             )
             self._reader = threading.Thread(target=self._read_loop, daemon=True)
@@ -199,6 +202,9 @@ class NetworkConnection:
                                 wsproto.OP_PONG, payload, mask=True
                             )
                         )
+                        continue
+                    if opcode == wsproto.OP_BINARY:
+                        self._on_binary(payload)
                         continue
                     if opcode == wsproto.OP_TEXT:
                         self._on_message(json.loads(payload.decode()))
@@ -309,8 +315,24 @@ class NetworkConnection:
 
     # -- LocalConnection surface -------------------------------------------
 
+    def _on_binary(self, payload: bytes) -> None:
+        """A sequenced op frame: expand through the same watermark ingest
+        (client rates are interactive — per-op expansion is fine HERE;
+        it is the service that must never pay it)."""
+        from fluidframework_tpu.protocol.opframe import SeqFrame
+
+        for m in SeqFrame.decode(payload).messages():
+            self._ingest(m)
+
     def submit(self, msg: DocumentMessage) -> None:
         self._send_json({"type": "submitOp", "op": to_jsonable(msg)})
+
+    def submit_frame(self, frame) -> None:
+        """Ship a batch of string-kernel ops as ONE binary ws frame
+        (protocol/opframe.py) — the high-throughput client wire."""
+        self._sock.sendall(
+            wsproto.encode_frame(wsproto.OP_BINARY, frame.encode(), mask=True)
+        )
 
     def submit_signal(self, content) -> None:
         self._send_json({"type": "submitSignal", "content": content})

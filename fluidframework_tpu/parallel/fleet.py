@@ -157,6 +157,10 @@ class _Pool:
         self.n_slots = n_slots
         self.state = jax.device_put(_np_batched_state(n_slots, capacity))
         self.doc_of_slot = np.full(n_slots, -1, np.int32)
+        # Placement generation per slot: bumped whenever the occupant
+        # changes, so a one-boxcar-stale health scan cannot attribute a
+        # departed doc's count/err to the slot's new occupant.
+        self.slot_gen = np.zeros(n_slots, np.int64)
         if kernel == "pallas":
             self._step = _pallas_step
             self._compact = _pallas_compact_step
@@ -189,6 +193,9 @@ class _Pool:
         )
         self.doc_of_slot = np.concatenate(
             [self.doc_of_slot, np.full(extra, -1, np.int32)]
+        )
+        self.slot_gen = np.concatenate(
+            [self.slot_gen, np.zeros(extra, np.int64)]
         )
         self.n_slots += extra
 
@@ -239,6 +246,7 @@ class DocFleet:
             pool.grow_slots()
             slot = pool.free_slot()
         pool.doc_of_slot[slot] = doc
+        pool.slot_gen[slot] += 1
         self.placement.append((self.base_capacity, slot))
         return doc
 
@@ -304,17 +312,33 @@ class DocFleet:
         """Start an async (count, err) readback of every pool; returns a
         token for :meth:`finish_scan`. Device arrays snapshot the state
         at call time, so consuming the token after further dispatches
-        reads a consistent (if slightly stale) view."""
+        reads a consistent (if slightly stale) view. The token also
+        snapshots each pool's slot generations: a slot whose occupant
+        changed between begin and finish is dropped at finish (its scan
+        column describes the departed doc, not the new one)."""
         token = {}
         for cap, pool in self.pools.items():
             dev = _pool_scan(pool.state)
             dev.copy_to_host_async()
-            token[cap] = dev
+            token[cap] = (dev, pool.slot_gen.copy())
         return token
 
     def finish_scan(self, token) -> Dict[int, np.ndarray]:
-        """Wait for a begin_scan token: cap -> [2, n_slots] host array."""
-        return {cap: np.asarray(dev) for cap, dev in token.items()}
+        """Wait for a begin_scan token: cap -> [2, n_slots] host array.
+        Columns for slots reassigned since begin_scan are zeroed (no
+        false promotion/nack for the new occupant; the next scan sees
+        its true state)."""
+        out = {}
+        for cap, (dev, gen_snap) in token.items():
+            arr = np.array(dev)
+            pool = self.pools.get(cap)
+            if pool is not None:
+                n = min(arr.shape[1], len(gen_snap), len(pool.slot_gen))
+                stale = pool.slot_gen[:n] != gen_snap[:n]
+                if stale.any():
+                    arr[:, :n][:, stale] = 0
+            out[cap] = arr
+        return out
 
     def compact(self) -> None:
         for pool in self.pools.values():
@@ -392,7 +416,9 @@ class DocFleet:
                 getattr(dst_host, s)[dst_slot] = getattr(src_host, s)[slot]
                 getattr(src_host, s)[slot] = np.asarray(getattr(empty, s))[0]
             pool.doc_of_slot[slot] = -1
+            pool.slot_gen[slot] += 1
             dst.doc_of_slot[dst_slot] = doc
+            dst.slot_gen[dst_slot] += 1
             self.placement[doc] = (new_cap, dst_slot)
             self.migrations += 1
         pool.state = jax.device_put(src_host)
@@ -450,6 +476,7 @@ class DocFleet:
             getattr(host, s)[slot] = np.asarray(getattr(empty, s))[0]
         pool.state = jax.device_put(host)
         pool.doc_of_slot[slot] = -1
+        pool.slot_gen[slot] += 1
         self.placement[doc] = None
         return state
 

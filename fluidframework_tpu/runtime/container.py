@@ -277,7 +277,15 @@ class ContainerRuntime:
         """Pack a logical batch through the outbox pipeline (compression /
         chunking / batch marks, D.1) and submit the wire messages. Pending
         entries record the wire clientSequenceNumber whose sequencing acks
-        each logical op."""
+        each logical op.
+
+        Frame fast path: a run of string-kernel ops on one channel over a
+        frame-capable connection ships as ONE binary op frame
+        (protocol/opframe.py) — the batched wire the service tickets and
+        stages without per-op Python. Acks are unchanged: frames consume
+        one clientSequenceNumber per op and come back expanded."""
+        if self._try_send_frame(batch):
+            return
         envelopes = [
             {"address": channel_id, "contents": contents}
             for channel_id, contents, _meta in batch
@@ -318,6 +326,60 @@ class ContainerRuntime:
                 self._offline.extend(batch[i] for i in unsent)
                 self.connected = False
                 return
+
+    def _try_send_frame(self, batch: list) -> bool:
+        """Ship ``batch`` as one binary op frame if every op is a
+        string-kernel op on the same channel and the connection speaks
+        frames; returns False to fall through to the JSON wire."""
+        if len(batch) < 2:
+            return False
+        submit_frame = getattr(self.connection, "submit_frame", None)
+        if submit_frame is None:
+            return False
+        addr = None
+        for channel_id, contents, _meta in batch:
+            if (
+                not isinstance(contents, dict)
+                or contents.get("k") not in ("ins", "rem", "ann")
+            ):
+                return False
+            if addr is None:
+                addr = channel_id
+            elif channel_id != addr:
+                return False
+        from fluidframework_tpu.protocol.opframe import OpFrame
+
+        kinds, a, b, tv = [], [], [], []
+        for _cid, c, _meta in batch:
+            k = c["k"]
+            kinds.append(k)
+            if k == "ins":
+                a.append(c["pos"])
+                b.append(c["orig"])
+                tv.append(c["text"])
+            else:
+                a.append(c["start"])
+                b.append(c["end"])
+                tv.append(c.get("val"))
+        frame = OpFrame.build(
+            addr, kinds, a, b, tv, self.client_seq + 1, self.ref_seq
+        )
+        for channel_id, contents, local_metadata in batch:
+            self.client_seq += 1
+            self.pending.append(
+                (self.client_seq, channel_id, contents, local_metadata)
+            )
+        try:
+            submit_frame(frame)
+        except OSError:
+            # Same unwind contract as the per-op path: nothing from this
+            # frame reached the service (one send, all-or-nothing).
+            for _ in batch:
+                self.pending.pop()
+            self.client_seq -= len(batch)
+            self._offline.extend(batch)
+            self.connected = False
+        return True
 
     # -- inbound (process, §3.2) ----------------------------------------------
 

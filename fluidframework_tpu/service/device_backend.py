@@ -154,8 +154,32 @@ class DeviceFleetBackend:
         ):
             return
         self._buffered_seq[key] = seq
-        self._buffers.setdefault(idx, []).append(row)
+        self._buffers.setdefault(idx, []).append(row[None, :])
         self._buffered_rows += 1
+        if self._buffered_rows >= self.max_batch:
+            self.flush()
+
+    def enqueue_frame(self, doc_id: str, frame) -> None:
+        """Buffer a whole sequenced op frame (the batched binary wire,
+        protocol/opframe.py) — same replay-idempotence contract as
+        :meth:`enqueue`, vectorized: the frame's contiguous seq run is
+        truncated at the channel watermark in one comparison, insert
+        payloads land in the channel dict in one update."""
+        key = (doc_id, frame.address)
+        idx = self.ensure(doc_id, frame.address)
+        water = max(
+            self.applied_seq[key], self._buffered_seq.get(key, 0)
+        )
+        skip = water - frame.first_seq + 1
+        rows = frame.rows if skip <= 0 else frame.rows[skip:]
+        if rows.shape[0] == 0:
+            return
+        origs, texts = frame.insert_payloads()
+        if texts:
+            self.payloads[key].update(zip(origs.tolist(), texts))
+        self._buffered_seq[key] = int(rows[-1, F_SEQ])
+        self._buffers.setdefault(idx, []).append(rows)
+        self._buffered_rows += rows.shape[0]
         if self._buffered_rows >= self.max_batch:
             self.flush()
 
@@ -199,9 +223,12 @@ class DeviceFleetBackend:
                 scans = self.fleet.finish_scan(self._scan_token)
                 self._scan_token = None
                 self._consume_scan(scans, newly_errored)
-            take: Dict[int, List[np.ndarray]] = {}
+            take: Dict[int, np.ndarray] = {}
             rest: Dict[int, List[np.ndarray]] = {}
-            for idx, rows in self._buffers.items():
+            for idx, chunks in self._buffers.items():
+                # Buffer entries are [k, OP_WIDTH] arrays (frames arrive
+                # whole); coalesce to one per channel for this round.
+                rows = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
                 # Fleet docs chunk to HALF their tier's promotion
                 # headroom: the promotion trigger is one boxcar stale, so
                 # two flushes of growth must fit between high_water and
@@ -215,7 +242,7 @@ class DeviceFleetBackend:
                     )
                 take[idx] = rows[:limit]
                 if len(rows) > limit:
-                    rest[idx] = rows[limit:]
+                    rest[idx] = [rows[limit:]]
             self._buffers = rest
             k = max(len(r) for r in take.values())
             k = _pow2_at_least(max(k, 8))
@@ -396,8 +423,8 @@ class DeviceFleetBackend:
         readback — the device scribe's work list. Buffered rows count:
         flush-before-summarize is the scribe's first step anyway."""
         pending: Dict[ChannelKey, int] = {}
-        for idx, rows in self._buffers.items():
-            pending[self._keys[idx]] = len(rows)
+        for idx, chunks in self._buffers.items():
+            pending[self._keys[idx]] = sum(c.shape[0] for c in chunks)
         return [
             key
             for key in self._keys

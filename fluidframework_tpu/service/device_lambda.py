@@ -43,6 +43,12 @@ class TpuDeliLambda(PartitionLambda):
         self._rmp = RemoteMessageProcessor()
 
     def handler(self, key: str, value: dict) -> List[Tuple[str, str, Any]]:
+        if value["t"] == "seqframe":
+            # Batched binary wire (protocol/opframe.py): the rows ARE
+            # kernel rows, already stamped — no per-op decode at all.
+            frame = value["frame"]
+            self.backend.enqueue_frame(self.doc_id, frame)
+            return []
         if value["t"] != "seq":
             return []
         msg = self._rmp.process(value["msg"])

@@ -24,9 +24,10 @@ seq, broadcaster drops seqs already delivered to a connection).
 
 from __future__ import annotations
 
-import copy
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
 
 from fluidframework_tpu.protocol.types import (
     DocumentMessage,
@@ -52,7 +53,13 @@ SIGNALS_TOPIC = "signals"
 
 class PartitionLambda:
     """IPartitionLambda: handle one record, emit (topic, key, value) tuples;
-    expose/restore durable state for checkpoints."""
+    expose/restore durable state for checkpoints.
+
+    ``state()`` MUST return an independent snapshot (no references to
+    live mutable structures): the checkpoint store keeps it as-is — a
+    defensive deepcopy per checkpoint was the single largest cost on the
+    serving pipeline at fleet scale. ``restore`` likewise must not
+    mutate the state object it is given."""
 
     def handler(self, key: str, value: Any) -> List[Tuple[str, str, Any]]:
         raise NotImplementedError
@@ -73,7 +80,12 @@ class CheckpointStore:
     per-document dict merged into the stored one (the reference
     checkpoints dirty document state, not the whole partition —
     ``deli/checkpointManager.ts``; serializing every doc every
-    checkpoint is quadratic at fleet scale)."""
+    checkpoint is quadratic at fleet scale).
+
+    States are stored WITHOUT a defensive copy: ``PartitionLambda.
+    state()`` contracts to return an independent snapshot, and
+    ``restore`` to treat its input as read-only (deepcopying every
+    checkpoint was the dominant host cost of the serving pipeline)."""
 
     def __init__(self) -> None:
         self._data: Dict[Tuple[str, int], dict] = {}
@@ -85,16 +97,13 @@ class CheckpointStore:
                 (group, partition), {"offset": 0, "state": {}}
             )
             ent["offset"] = offset
-            ent["state"].update(copy.deepcopy(state))
+            ent["state"].update(state)
             return
-        self._data[(group, partition)] = {
-            "offset": offset,
-            "state": copy.deepcopy(state),
-        }
+        self._data[(group, partition)] = {"offset": offset, "state": state}
 
     def load(self, group: str, partition: int) -> Optional[dict]:
         ent = self._data.get((group, partition))
-        return copy.deepcopy(ent) if ent else None
+        return {"offset": ent["offset"], "state": ent["state"]} if ent else None
 
 
 class DocumentLambda(PartitionLambda):
@@ -280,6 +289,8 @@ class DeliDocLambda(PartitionLambda):
             elif res is not None:
                 out.append((DELTAS_TOPIC, key, {"t": "seq", "msg": res}))
             # duplicates (None) are dropped silently (checkOrder)
+        elif t == "opframe":
+            out.extend(self._handle_frame(key, value))
         elif t == "summary_decision":
             ack = self.sequencer._sequence_system(
                 MessageType.SUMMARY_ACK if value["ok"] else MessageType.SUMMARY_NACK,
@@ -305,6 +316,46 @@ class DeliDocLambda(PartitionLambda):
             )
         else:  # pragma: no cover
             raise ValueError(f"unknown raw record {value!r}")
+        return out
+
+    def _handle_frame(self, key: str, value: dict) -> List[Tuple[str, str, Any]]:
+        """Ticket a batched binary op frame (protocol/opframe.py) in one
+        vectorized call and emit the sequenced frame as ONE deltas record
+        — the wire path that keeps per-op Python off the serving path."""
+        from fluidframework_tpu.protocol.constants import (
+            F_CLIENT, F_MSN, F_REF, F_SEQ, F_TYPE, OP_INSERT,
+        )
+        from fluidframework_tpu.protocol.opframe import SeqFrame
+        from fluidframework_tpu.service.sequencer import FrameTicket
+
+        client = value["client"]
+        frame = value["frame"]
+        res = self.sequencer.ticket_frame(
+            client, frame.csn0, frame.n, frame.rows[:, F_REF]
+        )
+        if res is None:
+            return []
+        if isinstance(res, NackMessage):
+            return [(DELTAS_TOPIC, key, {"t": "nack", "client": client,
+                                         "nack": res})]
+        assert isinstance(res, FrameTicket)
+        rows = np.array(frame.rows[res.drop : res.drop + res.m], np.int32)
+        rows[:, F_SEQ] = res.seq0 + np.arange(res.m, dtype=np.int32)
+        rows[:, F_MSN] = res.msn
+        rows[:, F_CLIENT] = client
+        ins = frame.rows[:, F_TYPE] == OP_INSERT
+        t_lo = int(np.count_nonzero(ins[: res.drop]))
+        t_hi = int(np.count_nonzero(ins[: res.drop + res.m]))
+        sf = SeqFrame(
+            frame.address, client, frame.csn0 + res.drop, rows,
+            frame.texts[t_lo:t_hi], res.timestamp,
+        )
+        out: List[Tuple[str, str, Any]] = [
+            (DELTAS_TOPIC, key, {"t": "seqframe", "frame": sf})
+        ]
+        if res.trailing_nack is not None:
+            out.append((DELTAS_TOPIC, key, {"t": "nack", "client": client,
+                                            "nack": res.trailing_nack}))
         return out
 
 
@@ -364,8 +415,17 @@ class ScribeDocLambda(PartitionLambda):
 # Scriptorium — durable op log (the Mongo deltas collection)
 
 
+def stored_message(v) -> SequencedDocumentMessage:
+    """Materialize one ops-store entry: plain sequenced messages are
+    stored as-is; frame ops are stored as ``(SeqFrame, i)`` and expand
+    lazily here (read-time cost, only for the range a reader asks for)."""
+    return v[0].message(v[1]) if isinstance(v, tuple) else v
+
+
 class ScriptoriumLambda(PartitionLambda):
-    """Idempotent insert of sequenced ops keyed by (doc, seq)."""
+    """Idempotent insert of sequenced ops keyed by (doc, seq). Frame
+    records store one ``(frame, i)`` pointer per covered seq — readers
+    expand through :func:`stored_message`."""
 
     def __init__(self, ops_store: Dict[str, Dict[int, SequencedDocumentMessage]]):
         self.ops_store = ops_store
@@ -374,6 +434,12 @@ class ScriptoriumLambda(PartitionLambda):
         if value["t"] == "seq":
             msg = value["msg"]
             self.ops_store.setdefault(key, {})[msg.sequence_number] = msg
+        elif value["t"] == "seqframe":
+            frame = value["frame"]
+            store = self.ops_store.setdefault(key, {})
+            s0 = frame.first_seq
+            for i in range(frame.n):
+                store[s0 + i] = (frame, i)
         return []
 
     def state(self) -> Any:
@@ -399,6 +465,21 @@ class BroadcasterLambda(PartitionLambda):
                 if msg.sequence_number > conn.delivered_seq:
                     conn.inbox.append(msg)
                     conn.delivered_seq = msg.sequence_number
+        elif value["t"] == "seqframe":
+            # One inbox append per frame per connection; take_inbox (or
+            # the socket drain) expands. A partially-delivered frame
+            # (replay straddling the watermark) expands the tail only.
+            frame = value["frame"]
+            for conn in conns:
+                if frame.last_seq <= conn.delivered_seq:
+                    continue
+                if frame.first_seq > conn.delivered_seq:
+                    conn.inbox.append(frame)
+                else:
+                    conn.inbox.extend(
+                        frame.messages(conn.delivered_seq - frame.first_seq + 1)
+                    )
+                conn.delivered_seq = frame.last_seq
         elif value["t"] == "nack":
             for conn in conns:
                 if value.get("client") == conn.client_id or (

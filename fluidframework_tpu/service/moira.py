@@ -120,6 +120,15 @@ class MoiraLambda(PartitionLambda):
         return {"acked_seq": dict(self.acked_seq)}
 
     def handler(self, key: str, value: dict) -> List[Tuple[str, str, Any]]:
+        if value.get("t") == "seqframe":
+            # Batched binary wire: expand to per-op commits (the external
+            # index consumes one changeset per op; this path is opt-in
+            # and off the serving hot loop). Partial-failure safety is
+            # per-op: acked_seq advances as each commit lands, so a sink
+            # outage mid-frame replays only the tail.
+            for m in value["frame"].messages():
+                self.handler(key, {"t": "seq", "msg": m})
+            return []
         if value.get("t") != "seq":
             return []
         msg = value["msg"]

@@ -79,6 +79,7 @@ class _Session:
         self.doc_id: Optional[str] = None
         self.push_doc: Optional[str] = None
         self.push_seq = 0  # delivery watermark for push subscribers
+        self.frames_ok = False  # client negotiated the binary frame wire
 
 
 class FluidNetworkServer:
@@ -338,6 +339,12 @@ class FluidNetworkServer:
                             wsproto.encode_frame(wsproto.OP_PONG, payload)
                         )
                         continue
+                    if opcode == wsproto.OP_BINARY:
+                        # Batched binary op wire (protocol/opframe.py):
+                        # the payload IS planar kernel rows — one ticket
+                        # call, no per-op JSON on the serving path.
+                        self._on_frame(session, payload)
+                        continue
                     if opcode != wsproto.OP_TEXT:
                         continue
                     self._on_message(session, json.loads(payload.decode()))
@@ -365,6 +372,40 @@ class FluidNetworkServer:
             )
         )
 
+    def _on_frame(self, session: _Session, payload: bytes) -> None:
+        from fluidframework_tpu.protocol.opframe import OpFrame
+
+        if session.conn is None:
+            return
+        frame = OpFrame.decode(payload)
+        submit = getattr(session.conn, "submit_frame", None)
+        if submit is not None:
+            submit(frame)
+        else:
+            # Service without a frame front door (e.g. the in-memory
+            # local orderer): fall back to per-op submits — the wire
+            # stays usable everywhere, just without the batched ticket.
+            from fluidframework_tpu.protocol.constants import (
+                F_REF, F_SEQ, F_TYPE, OP_INSERT,
+            )
+            from fluidframework_tpu.protocol.opframe import row_contents
+            from fluidframework_tpu.protocol.types import (
+                DocumentMessage, MessageType,
+            )
+
+            ti = 0
+            for i in range(frame.n):
+                r = frame.rows[i]
+                c = row_contents(r, frame.texts, ti)
+                if int(r[F_TYPE]) == OP_INSERT:
+                    ti += 1
+                session.conn.submit(DocumentMessage(
+                    client_sequence_number=int(r[F_SEQ]),
+                    reference_sequence_number=int(r[F_REF]),
+                    type=MessageType.OPERATION,
+                    contents={"address": frame.address, "contents": c},
+                ))
+
     def _on_message(self, session: _Session, msg: dict) -> None:
         t = msg.get("type")
         if t == "connect_document":
@@ -389,6 +430,7 @@ class FluidNetworkServer:
                 return
             session.conn = conn
             session.doc_id = doc_id
+            session.frames_ok = bool(msg.get("frames", False))
             self._send(
                 session,
                 {
@@ -487,8 +529,18 @@ class FluidNetworkServer:
                 continue
             if s.conn is None:
                 continue
-            for m in s.conn.take_inbox():
-                self._send(s, {"type": "op", "msg": to_jsonable(m)})
+            take_raw = (
+                getattr(s.conn, "take_inbox_raw", None)
+                if s.frames_ok else None
+            )
+            for m in (take_raw() if take_raw else s.conn.take_inbox()):
+                if hasattr(m, "sequence_number"):
+                    self._send(s, {"type": "op", "msg": to_jsonable(m)})
+                else:
+                    # SeqFrame: n sequenced ops in ONE binary ws frame.
+                    s.writer.write(
+                        wsproto.encode_frame(wsproto.OP_BINARY, m.encode())
+                    )
             sigs, s.conn.signals[:] = list(s.conn.signals), []
             for sig in sigs:
                 self._send(

@@ -38,6 +38,7 @@ from fluidframework_tpu.service.lambdas import (
     ScribeDocLambda,
     ScriptoriumLambda,
     SignalBroadcasterLambda,
+    stored_message,
 )
 from fluidframework_tpu.service.queue import PartitionedLog
 from fluidframework_tpu.service.summary_store import SummaryStore
@@ -66,13 +67,36 @@ class PipelineConnection:
     def submit(self, msg: DocumentMessage) -> None:
         self.service.submit(self.doc_id, self.client_id, msg)
 
+    def submit_frame(self, frame) -> None:
+        """Submit a batched binary op frame (protocol/opframe.py) — the
+        high-throughput wire; per-op ``submit`` remains the compat path."""
+        self.service.submit_frame(self.doc_id, self.client_id, frame)
+
     def submit_signal(self, content) -> None:
         self.service.submit_signal(self.doc_id, self.client_id, content)
 
     def take_inbox(self, n: Optional[int] = None) -> List[SequencedDocumentMessage]:
         self.service.pump()
+        if any(not hasattr(m, "sequence_number") for m in self.inbox):
+            # Frames ride the inbox whole (one broadcaster append per
+            # frame); expand to per-op messages at the consumption edge.
+            flat: List[SequencedDocumentMessage] = []
+            for m in self.inbox:
+                if hasattr(m, "sequence_number"):
+                    flat.append(m)
+                else:
+                    flat.extend(m.messages())
+            self.inbox[:] = flat
         n = len(self.inbox) if n is None else min(n, len(self.inbox))
         out, self.inbox[:] = self.inbox[:n], self.inbox[n:]
+        return out
+
+    def take_inbox_raw(self) -> list:
+        """Drain the inbox WITHOUT expanding frames — for frame-capable
+        transports (the network server ships SeqFrames as one binary
+        websocket frame instead of n JSON ops)."""
+        self.service.pump()
+        out, self.inbox[:] = list(self.inbox), []
         return out
 
     def disconnect(self) -> None:
@@ -309,6 +333,17 @@ class PipelineFluidService:
                 ):
                     self.device.flush()
                     self._nack_device_errors()
+                elif (
+                    self.device is not None
+                    and self.device._scan_token is not None
+                ):
+                    # No new rows, but the LAST boxcar's health scan is
+                    # still streaming: drain it so its capacity errors
+                    # surface on the ingestion path even if the stream
+                    # then goes idle (a direct embedder may never pump
+                    # again; the nack must not depend on future traffic).
+                    self.device.collect_now()
+                    self._nack_device_errors()
                 return total
 
     # -- the device serving surface -------------------------------------------
@@ -387,15 +422,17 @@ class PipelineFluidService:
         # Backfill from the durable op log, then join the live room.
         for seq in sorted(self.ops_store.get(doc_id, {})):
             if seq > from_seq:
-                conn.inbox.append(self.ops_store[doc_id][seq])
+                conn.inbox.append(stored_message(self.ops_store[doc_id][seq]))
                 conn.delivered_seq = seq
         conn.delivered_seq = max(conn.delivered_seq, from_seq)
         self.rooms.setdefault(doc_id, []).append(conn)
         self.log.send(RAW_TOPIC, doc_id, {"t": "join", "mode": mode, "token": token})
         self.pump()
         for msg in conn.inbox:
+            # Live frame traffic from other writers may land raw
+            # SeqFrames here; they are never joins — skip, don't expand.
             if (
-                msg.type == MessageType.CLIENT_JOIN
+                getattr(msg, "type", None) == MessageType.CLIENT_JOIN
                 and msg.contents.get("token") == token
             ):
                 conn.client_id = msg.contents["clientId"]
@@ -423,6 +460,15 @@ class PipelineFluidService:
         )
         self.pump()
 
+    def submit_frame(self, doc_id: str, client_id: int, frame) -> None:
+        """Front-door ingest for the batched binary wire: one raw record
+        per frame; deli tickets it vectorized (sequencer.ticket_frame)."""
+        self.log.send(
+            RAW_TOPIC, doc_id,
+            {"t": "opframe", "client": client_id, "frame": frame},
+        )
+        self.pump()
+
     def submit_signal(self, doc_id: str, client_id: int, content) -> None:
         self.log.send(
             RAW_TOPIC, doc_id,
@@ -444,7 +490,9 @@ class PipelineFluidService:
         self.pump()
         ops = self.ops_store.get(doc_id, {})
         return [
-            ops[s] for s in range(from_seq, to_seq + 1) if s in ops
+            stored_message(ops[s])
+            for s in range(from_seq, to_seq + 1)
+            if s in ops
         ]
 
     def get_deltas(
@@ -452,7 +500,7 @@ class PipelineFluidService:
     ) -> List[SequencedDocumentMessage]:
         self.pump()
         return [
-            m
+            stored_message(m)
             for seq, m in sorted(self.ops_store.get(doc_id, {}).items())
             if seq > from_seq and (to_seq is None or seq <= to_seq)
         ]

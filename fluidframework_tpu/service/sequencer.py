@@ -47,6 +47,18 @@ class _ClientEntry:
 
 
 @dataclass
+class FrameTicket:
+    """Result of a successful (possibly partial) ``ticket_frame``."""
+
+    drop: int  # leading replay duplicates dropped
+    m: int  # ops ticketed (rows drop..drop+m-1)
+    seq0: int  # first assigned sequence number (contiguous run of m)
+    msn: "object"  # np.ndarray [m] per-op minimum sequence numbers
+    timestamp: float
+    trailing_nack: Optional[NackMessage] = None  # first op past the valid run
+
+
+@dataclass
 class SequencerCheckpoint:
     """Durable sequencer state (reference ``IDeliState``,
     services-core/src/document.ts:56): enough to resume after a crash."""
@@ -279,6 +291,91 @@ class DocumentSequencer:
             timestamp=time.time(),
             traces=traces,
         )
+
+    def ticket_frame(
+        self, client_id: int, csn0: int, n: int, refs
+    ) -> Union["FrameTicket", NackMessage, None]:
+        """Vectorized ticket for an :class:`~fluidframework_tpu.protocol.
+        opframe.OpFrame`: n contiguous client ops in one call, with
+        per-op semantics identical to n ``ticket()`` calls on OPERATION
+        messages — duplicate csns drop from the front, the first invalid
+        op nacks and (as per-op ticketing would, via the resulting csn
+        gap) implicitly rejects everything after it, MSN advances per op.
+
+        Returns a :class:`FrameTicket` (drop count, valid count, seq0,
+        per-op msn array), a NackMessage (``client_sequence_number`` =
+        first rejected csn), or None when every op is a replay duplicate.
+        """
+        import numpy as np
+
+        entry = self.clients.get(client_id)
+        if entry is None:
+            return NackMessage(
+                self.seq, 400, NackErrorType.BAD_REQUEST, "unknown client"
+            )
+        if entry.mode != "write":
+            return NackMessage(
+                self.seq, 403, NackErrorType.INVALID_SCOPE, "read-only client"
+            )
+        if self._nack_all is not None:
+            return NackMessage(
+                self.seq, self._nack_all["code"],
+                NackErrorType.LIMIT_EXCEEDED, self._nack_all["message"],
+                retry_after_s=1.0, client_sequence_number=csn0,
+            )
+        drop = max(0, entry.client_seq - csn0 + 1)
+        if drop >= n:
+            return None  # whole frame is a replay duplicate
+        if csn0 + drop != entry.client_seq + 1:
+            return NackMessage(
+                self.seq, 400, NackErrorType.BAD_REQUEST,
+                f"clientSequenceNumber gap (expected {entry.client_seq + 1})",
+                client_sequence_number=csn0 + drop,
+            )
+        refs = np.asarray(refs, np.int32)[drop:]
+        n_rem = len(refs)
+        stale = refs < self.min_seq
+        m = int(np.argmax(stale)) if stale.any() else n_rem
+        if m == 0:
+            return NackMessage(
+                self.seq, 400, NackErrorType.BAD_REQUEST,
+                f"refSeq {int(refs[0])} below MSN {self.min_seq}",
+                client_sequence_number=csn0 + drop,
+            )
+        refs = refs[:m]
+        # MSN per op: min over clients' refSeq as of that op. Within the
+        # frame only THIS client's ref moves (op i sets it to refs[i]),
+        # so msn_i = max(floor, min(others_min, refs[i])), never
+        # regressing (accumulate guards a non-monotone refs column).
+        others = [
+            c.ref_seq for c in self.clients.values() if c.client_id != client_id
+        ]
+        cand = np.minimum(refs, min(others)) if others else refs
+        msn = np.maximum.accumulate(np.maximum(cand, self.min_seq))
+        # Per-op parity recheck: op i must also clear the MSN established
+        # BY op i-1 (per-op ticket() validates against the freshly
+        # advanced floor; without this a non-monotone refs column could
+        # publish min_seq above the sender's own recorded ref_seq).
+        viol = refs[1:] < msn[:-1]
+        if viol.any():
+            m = int(np.argmax(viol)) + 1
+            refs = refs[:m]
+            msn = msn[:m]
+        entry.client_seq = csn0 + drop + m - 1
+        entry.ref_seq = int(refs[-1])
+        entry.last_seen = time.time()
+        seq0 = self.seq + 1
+        self.seq += m
+        self.min_seq = int(msn[-1])
+        nack = None
+        if m < n_rem:
+            nack = NackMessage(
+                self.seq, 400, NackErrorType.BAD_REQUEST,
+                f"refSeq below MSN {self.min_seq}",
+                client_sequence_number=csn0 + drop + m,
+            )
+        return FrameTicket(drop=drop, m=m, seq0=seq0, msn=msn,
+                           timestamp=time.time(), trailing_nack=nack)
 
     # -- internals ------------------------------------------------------------
 

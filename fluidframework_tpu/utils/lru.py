@@ -35,6 +35,11 @@ class LruCache:
             old = self._d.pop(key, None)
             if old is not None:
                 self._bytes -= len(old)
+            if len(value) > self.capacity:
+                # An uncacheable oversized value must not flush the whole
+                # cache on every write — skip it (the entry it replaced,
+                # if any, stays evicted: it no longer reflects the store).
+                return
             self._d[key] = value
             self._bytes += len(value)
             while self._bytes > self.capacity:

@@ -166,3 +166,27 @@ def test_apply_sparse_pads_and_drops_out_of_range():
     assert materialize(fleet.doc_state(0), payloads) == "f"
     assert materialize(fleet.doc_state(2), payloads) == "g"
     assert fleet.stats()["docs_with_errors"] == 0
+
+
+def test_stale_scan_dropped_for_reassigned_slots():
+    """A health scan begun before a slot's occupant changed must not
+    attribute the departed doc's count/err to the new occupant
+    (ADVICE r4: placement generation per slot)."""
+    fleet = DocFleet(1, capacity=8, max_capacity=64)
+    # Fill doc 0 hot (above high water in the base tier).
+    ops = np.zeros((1, 8, OP_WIDTH), np.int32)
+    for i in range(7):
+        ops[0, i] = E.insert(0, i + 1, 1, seq=i + 1, ref=i, client=0)
+    fleet.apply(ops)
+    token = fleet.begin_scan()  # snapshot: slot 0 hot, gen G
+    # Occupant changes: doc 0 promotes out, doc 1 lands in its slot.
+    fleet.check_and_migrate()
+    assert fleet.placement[0][0] == 16
+    d1 = fleet.add_doc()
+    assert fleet.placement[d1] == (8, 0)  # reused the vacated slot
+    scans = fleet.finish_scan(token)
+    # The stale column (old occupant's count 7) is zeroed.
+    assert scans[8][0][0] == 0
+    # Consuming the stale scan must not re-promote the NEW occupant.
+    promoted = fleet.check_and_migrate({c: s[0] for c, s in scans.items()})
+    assert d1 not in promoted

@@ -93,6 +93,24 @@ def test_lru_cache_evicts_by_bytes():
     assert c.get("b") is None
 
 
+def test_lru_oversized_value_does_not_flush_cache():
+    """A value larger than the whole cache is uncacheable; writing it
+    (repeatedly) must not evict everything else (ADVICE r4)."""
+    c = LruCache(capacity_bytes=10)
+    c.set("a", b"12345")
+    c.set("b", b"1234")
+    for _ in range(3):
+        c.set("big", b"x" * 100)
+    assert c.get("big") is None
+    assert c.get("a") == b"12345"
+    assert c.get("b") == b"1234"
+    # Overwriting a cached key with an oversized value evicts the stale
+    # entry (it no longer reflects the store) without touching others.
+    c.set("a", b"y" * 100)
+    assert c.get("a") is None
+    assert c.get("b") == b"1234"
+
+
 def test_summary_reads_ride_the_cache():
     """get_summary walks tree + meta + channel blobs — all immutable, so
     a repeat read of the same handle touches the store zero times."""

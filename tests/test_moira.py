@@ -12,6 +12,7 @@ from fluidframework_tpu.service.moira import (
     MaterializedIndexSink,
     MoiraLambda,
 )
+from fluidframework_tpu.service.lambdas import stored_message
 from fluidframework_tpu.service.pipeline import PipelineFluidService
 
 
@@ -49,7 +50,10 @@ def test_moira_streams_every_content_op():
     # Every content-bearing sequenced op is indexed exactly once, in
     # order (joins/noops are not changesets).
     ops = [
-        s for s, m in sorted(svc.ops_store["doc"].items())
+        s for s, m in sorted(
+            (k, stored_message(v))
+            for k, v in svc.ops_store["doc"].items()
+        )
         if m.type == 1 and m.contents is not None
     ]
     assert seqs == ops
@@ -62,7 +66,15 @@ def test_moira_kill_restart_converges_without_gaps_or_dups():
         n_partitions=2, device_backend=False, index_sink=sink,
         checkpoint_every=3,
     )
-    a = _author(svc, 6)
+    # Author one op per drain: per-op deltas records keep the moira
+    # checkpoint strictly inside the record stream, so the crash below
+    # has a genuine replay window. (Multi-op flushes ride the frame
+    # wire as ONE record — checkpoint_every=3 could then land exactly
+    # on the log head and the replay-absorption proof would be vacuous.)
+    a = ContainerRuntime(svc, "doc", channels=(SharedString("s"),))
+    for i in range(6):
+        a.get_channel("s").insert_text(0, f"w{i} ")
+        drain([a])
     before = _indexed_seqs(sink)
     assert before, "stream must have started"
     # Kill the streamer; its checkpoint may trail the sink (records
@@ -70,10 +82,13 @@ def test_moira_kill_restart_converges_without_gaps_or_dups():
     svc.crash_moira(checkpoint_every=3)
     for i in range(6, 12):
         a.get_channel("s").insert_text(0, f"w{i} ")
-    drain([a])
+        drain([a])
     after = _indexed_seqs(sink)
     ops = [
-        s for s, m in sorted(svc.ops_store["doc"].items())
+        s for s, m in sorted(
+            (k, stored_message(v))
+            for k, v in svc.ops_store["doc"].items()
+        )
         if m.type == 1 and m.contents is not None
     ]
     assert after == ops, "index must converge gap-free after restart"
@@ -101,7 +116,10 @@ def test_moira_sink_outage_retries_without_stalling_pipeline():
     for _ in range(8):
         svc.pump()
     ops = [
-        s for s, m in sorted(svc.ops_store["doc"].items())
+        s for s, m in sorted(
+            (k, stored_message(v))
+            for k, v in svc.ops_store["doc"].items()
+        )
         if m.type == 1 and m.contents is not None
     ]
     assert _indexed_seqs(sink) == ops
