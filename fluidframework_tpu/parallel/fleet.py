@@ -73,6 +73,23 @@ def _scatter_rows(rows_b, slots, n_slots):
     return dense.at[slots].set(rows_b)
 
 
+@functools.lru_cache(maxsize=None)
+def _scatter_fn(sharding):
+    """The scatter above, specialized to land its dense output PRE-SHARDED
+    over the pool's mesh (out_shardings) — without this, a mesh fleet
+    materializes every boxcar's full dense batch on one device and
+    reshards it inside the apply step (code-review r5)."""
+    if sharding is None:
+        return _scatter_rows
+
+    def f(rows_b, slots, n_slots):
+        k = rows_b.shape[1]
+        dense = jnp.zeros((n_slots, k, rows_b.shape[2]), jnp.int32)
+        return dense.at[slots].set(rows_b)
+
+    return jax.jit(f, static_argnums=(2,), out_shardings=sharding)
+
+
 @jax.jit
 def _pool_scan(state: SegmentState):
     """One [2, n_slots] (count, err) scan per pool — the fused health
@@ -152,10 +169,17 @@ class _Pool:
     ``doc_of_slot`` is an int32 array (-1 = free) so batch routing is a
     vectorized gather, not a Python slot loop (VERDICT r2 Weak #4)."""
 
-    def __init__(self, capacity: int, n_slots: int, kernel: str = "xla"):
+    def __init__(self, capacity: int, n_slots: int, kernel: str = "xla",
+                 sharding=None):
         self.capacity = capacity
+        # Mesh placement: the slot axis shards over the mesh's docs axis,
+        # so n_slots must stay a multiple of the device count (pow2 slot
+        # counts at or above the mesh size always are).
+        if sharding is not None:
+            n_slots = max(n_slots, sharding.mesh.devices.size)
         self.n_slots = n_slots
-        self.state = jax.device_put(_np_batched_state(n_slots, capacity))
+        self.sharding = sharding
+        self.state = self._put(_np_batched_state(n_slots, capacity))
         self.doc_of_slot = np.full(n_slots, -1, np.int32)
         # Placement generation per slot: bumped whenever the occupant
         # changes, so a one-boxcar-stale health scan cannot attribute a
@@ -167,6 +191,13 @@ class _Pool:
         else:
             self._step = _jit_step
             self._compact = _jit_compact
+
+    def _put(self, host: SegmentState):
+        """Host state -> device, honoring the pool's mesh sharding (the
+        doc/slot axis spreads over the mesh; lanes keep dim 1 local)."""
+        if self.sharding is None:
+            return jax.device_put(host)
+        return jax.device_put(host, self.sharding)
 
     def free_slot(self) -> Optional[int]:
         free = np.flatnonzero(self.doc_of_slot < 0)
@@ -183,7 +214,7 @@ class _Pool:
         shape, cached per shape thereafter)."""
         extra = self.n_slots
         pad = _np_batched_state(extra, self.capacity)
-        self.state = jax.device_put(
+        self.state = self._put(
             SegmentState(
                 *[
                     np.concatenate([np.array(a), b], axis=0)
@@ -212,16 +243,34 @@ class DocFleet:
         high_water: float = 0.75,
         max_capacity: int = 1 << 16,
         kernel: str = "auto",
+        mesh=None,
+        axis: str = "docs",
     ):
         self.n_docs = n_docs
         self.high_water = high_water
         self.max_capacity = max_capacity
         self.base_capacity = capacity
+        # Mesh-sharded serving fleet (SURVEY.md:13-15 — "per-partition
+        # lambdas shard documents across a TPU mesh"): every pool's slot
+        # axis spreads over the mesh's docs axis; the apply path has no
+        # cross-document dependencies, so GSPMD partitions the vmapped
+        # kernels with no collectives (only scans/stats all-reduce).
+        self.mesh = mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._sharding = NamedSharding(mesh, PartitionSpec(axis))
+            # The Pallas engine runs per-device VMEM kernels and needs
+            # shard_map (DocShard implements that shape); the pooled
+            # lifecycle fleet rides GSPMD over the XLA kernels.
+            kernel = "xla"
+        else:
+            self._sharding = None
         # Kernel engine: "pallas" (VMEM blocks — the TPU default) or
         # "xla" (vmapped scan — the CPU/test default under "auto").
         self.kernel = _resolve_kernel(kernel)
         n_slots = _pow2_at_least(n_docs)
-        pool = _Pool(capacity, n_slots, self.kernel)
+        pool = _Pool(capacity, n_slots, self.kernel, self._sharding)
         pool.doc_of_slot[:n_docs] = np.arange(n_docs)
         self.pools: Dict[int, _Pool] = {capacity: pool}
         self.placement: List[Tuple[int, int]] = [
@@ -239,7 +288,7 @@ class DocFleet:
         pool = self.pools.get(self.base_capacity)
         if pool is None:
             pool = self.pools[self.base_capacity] = _Pool(
-                self.base_capacity, 1, self.kernel
+                self.base_capacity, 1, self.kernel, self._sharding
             )
         slot = pool.free_slot()
         if slot is None:
@@ -302,7 +351,7 @@ class DocFleet:
                 rows_b[j] = ops_b[i]
                 slots[j] = self.placement[docs[i]][1]
             routing += time.perf_counter() - t0
-            dense = _scatter_rows(
+            dense = _scatter_fn(pool.sharding)(
                 jnp.asarray(rows_b), jnp.asarray(slots), pool.n_slots
             )
             pool.state = pool._step(pool.state, dense)
@@ -390,7 +439,8 @@ class DocFleet:
         dst = self.pools.get(new_cap)
         if dst is None:
             dst = self.pools[new_cap] = _Pool(
-                new_cap, _pow2_at_least(len(hot)), self.kernel
+                new_cap, _pow2_at_least(len(hot)), self.kernel,
+                self._sharding,
             )
         while dst.n_free() < len(hot):
             dst.grow_slots()
@@ -421,8 +471,8 @@ class DocFleet:
             dst.slot_gen[dst_slot] += 1
             self.placement[doc] = (new_cap, dst_slot)
             self.migrations += 1
-        pool.state = jax.device_put(src_host)
-        dst.state = jax.device_put(dst_host)
+        pool.state = pool._put(src_host)
+        dst.state = dst._put(dst_host)
 
     def _hot_slots(
         self, pool: _Pool, cap: int, counts: Optional[np.ndarray] = None
@@ -474,7 +524,7 @@ class DocFleet:
             getattr(host, lane)[slot] = np.asarray(getattr(empty, lane))[0]
         for s in _SCALARS:
             getattr(host, s)[slot] = np.asarray(getattr(empty, s))[0]
-        pool.state = jax.device_put(host)
+        pool.state = pool._put(host)
         pool.doc_of_slot[slot] = -1
         pool.slot_gen[slot] += 1
         self.placement[doc] = None

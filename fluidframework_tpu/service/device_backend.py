@@ -55,9 +55,13 @@ class DeviceFleetBackend:
         compact_every: int = 8,
         max_capacity: int = 1 << 16,
         sharded_overflow: bool = False,
+        mesh=None,
     ):
+        # ``mesh``: shard every fleet pool's document axis over a
+        # jax.sharding.Mesh — the serving deployment shape (per-partition
+        # lambdas shard documents across a TPU mesh, SURVEY.md:13-15).
         self.fleet = DocFleet(
-            0, capacity, max_capacity=max_capacity
+            0, capacity, max_capacity=max_capacity, mesh=mesh
         )
         self.max_batch = max_batch
         self.compact_every = compact_every
@@ -102,11 +106,16 @@ class DeviceFleetBackend:
         # traced to exactly this). Once per process per capacity — the
         # jit cache is global, so later backends skip even the throwaway
         # dispatches.
-        key = (capacity, max_capacity)
+        key = (
+            capacity, max_capacity,
+            None if mesh is None else tuple(d.id for d in mesh.devices.flat),
+        )
         if key not in _WARMED:
             _WARMED.add(key)
             for slots in (1, 2, 4):
-                warm = DocFleet(slots, capacity, max_capacity=max_capacity)
+                warm = DocFleet(
+                    slots, capacity, max_capacity=max_capacity, mesh=mesh
+                )
                 warm.apply(np.zeros((slots, 8, OP_WIDTH), np.int32))
                 # The serving path flushes through the SPARSE staging +
                 # the async health scan — warm those too (their first

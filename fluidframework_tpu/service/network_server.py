@@ -478,6 +478,12 @@ class FluidNetworkServer:
         # device RTT every tick starves socket IO); the barrier
         # (collect_now) runs only once the ingest goes quiet, so sticky
         # errors still surface within a tick of the last boxcar.
+        # One pipeline sweep per drain tick; per-session drains then skip
+        # their own pump (a pump per session per inbound message made the
+        # socket path O(sessions^2) in pipeline sweeps).
+        svc_pump = getattr(self.service, "pump", None)
+        if svc_pump is not None:
+            svc_pump()
         dev = getattr(self.service, "device", None)
         if dev is not None:
             now = time.monotonic()
@@ -529,11 +535,19 @@ class FluidNetworkServer:
                 continue
             if s.conn is None:
                 continue
+            nopump = getattr(s.conn, "supports_nopump", False)
             take_raw = (
                 getattr(s.conn, "take_inbox_raw", None)
                 if s.frames_ok else None
             )
-            for m in (take_raw() if take_raw else s.conn.take_inbox()):
+            if take_raw is not None:
+                msgs = take_raw(pump=False) if nopump else take_raw()
+            else:
+                msgs = (
+                    s.conn.take_inbox(pump=False)
+                    if nopump else s.conn.take_inbox()
+                )
+            for m in msgs:
                 if hasattr(m, "sequence_number"):
                     self._send(s, {"type": "op", "msg": to_jsonable(m)})
                 else:

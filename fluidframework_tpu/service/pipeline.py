@@ -75,8 +75,16 @@ class PipelineConnection:
     def submit_signal(self, content) -> None:
         self.service.submit_signal(self.doc_id, self.client_id, content)
 
-    def take_inbox(self, n: Optional[int] = None) -> List[SequencedDocumentMessage]:
-        self.service.pump()
+    # The socket server pumps the service ONCE per drain tick and then
+    # drains every session without re-pumping (a per-session pump made
+    # the drain O(sessions^2) in pipeline sweeps).
+    supports_nopump = True
+
+    def take_inbox(
+        self, n: Optional[int] = None, *, pump: bool = True
+    ) -> List[SequencedDocumentMessage]:
+        if pump:
+            self.service.pump()
         if any(not hasattr(m, "sequence_number") for m in self.inbox):
             # Frames ride the inbox whole (one broadcaster append per
             # frame); expand to per-op messages at the consumption edge.
@@ -91,11 +99,12 @@ class PipelineConnection:
         out, self.inbox[:] = self.inbox[:n], self.inbox[n:]
         return out
 
-    def take_inbox_raw(self) -> list:
+    def take_inbox_raw(self, *, pump: bool = True) -> list:
         """Drain the inbox WITHOUT expanding frames — for frame-capable
         transports (the network server ships SeqFrames as one binary
         websocket frame instead of n JSON ops)."""
-        self.service.pump()
+        if pump:
+            self.service.pump()
         out, self.inbox[:] = list(self.inbox), []
         return out
 
@@ -117,6 +126,7 @@ class PipelineFluidService:
         device_sharded_overflow: bool = False,
         device_max_batch: int = 512,
         device_flush_min_rows: int = 1,
+        device_mesh=None,
         foreman_tasks: tuple = ("summarizer",),
         index_sink: Optional[Any] = None,
         log: Optional[Any] = None,
@@ -203,12 +213,12 @@ class PipelineFluidService:
         if device_backend:
             self._make_device(
                 device_capacity, device_max_capacity,
-                device_sharded_overflow, device_max_batch,
+                device_sharded_overflow, device_max_batch, device_mesh,
             )
 
     def _make_device(
         self, capacity: int, max_capacity: int, sharded_overflow: bool,
-        max_batch: int = 512,
+        max_batch: int = 512, mesh=None,
     ) -> None:
         from fluidframework_tpu.service.device_backend import (
             DeviceFleetBackend,
@@ -218,9 +228,10 @@ class PipelineFluidService:
         self.device = DeviceFleetBackend(
             capacity=capacity, max_capacity=max_capacity,
             sharded_overflow=sharded_overflow, max_batch=max_batch,
+            mesh=mesh,
         )
         self._device_capacity = (
-            capacity, max_capacity, sharded_overflow, max_batch,
+            capacity, max_capacity, sharded_overflow, max_batch, mesh,
         )
 
         def factory(p: int, state):
