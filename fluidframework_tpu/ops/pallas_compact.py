@@ -27,6 +27,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams; support both so the
+# compact kernels trace on CI images as well as the TPU driver image.
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
 from fluidframework_tpu.ops.pallas_kernel import (
     N_LANES,
     N_SCALARS,
@@ -195,7 +201,7 @@ def compact_packed(tables, scalars, *, block_docs=8, interpret=False):
         input_output_aliases={0: 0, 1: 1},
         # 14 lanes of permutation transport sit marginally past Mosaic's
         # default 16MB scoped stack at cap 256 — grant headroom.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=64 * 1024 * 1024
         ),
         interpret=interpret,
@@ -284,7 +290,7 @@ def apply_compact_packed(tables, scalars, ops, *, block_docs=8, interpret=False)
         # The fused body carries the apply loop's lanes plus both
         # permutation matmuls on one scoped stack — far past Mosaic's
         # default 16MB; grant most of the chip's VMEM.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024
         ),
         interpret=interpret,

@@ -26,6 +26,22 @@ from fluidframework_tpu.ops.segment_state import SegmentState, make_batched_stat
 from fluidframework_tpu.protocol.constants import NO_CLIENT
 
 
+def compat_shard_map(f, mesh, in_specs, out_specs):
+    """shard_map across jax versions: the top-level export (with
+    ``check_vma`` — pallas_call outputs carry no vma info) where present,
+    else the experimental module (whose flag is ``check_rep``)."""
+    try:
+        from jax import shard_map as sm
+
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = "docs") -> Mesh:
     devs = jax.devices()
     if n_devices is not None:
@@ -111,8 +127,6 @@ class DocShard:
     # -- pallas backend -------------------------------------------------------
 
     def _make_pallas_step(self):
-        from jax import shard_map
-
         from fluidframework_tpu.ops.pallas_kernel import (
             SC_COUNT,
             SC_CUR_SEQ,
@@ -148,20 +162,17 @@ class DocShard:
             return tables, scalars, stats
 
         return jax.jit(
-            shard_map(
+            compat_shard_map(
                 per_shard,
                 mesh=self.mesh,
                 in_specs=(P(None, axis, None), P(axis, None),
                           P(axis, None, None)),
                 out_specs=(P(None, axis, None), P(axis, None), P()),
-                check_vma=False,  # pallas_call outputs carry no vma info
             ),
             donate_argnums=(0, 1),
         )
 
     def _make_pallas_compact(self):
-        from jax import shard_map
-
         from fluidframework_tpu.ops.pallas_compact import compact_packed
 
         axis = self.axis
@@ -171,12 +182,11 @@ class DocShard:
             return compact_packed(tables, scalars, interpret=interpret)
 
         return jax.jit(
-            shard_map(
+            compat_shard_map(
                 per_shard,
                 mesh=self.mesh,
                 in_specs=(P(None, axis, None), P(axis, None)),
                 out_specs=(P(None, axis, None), P(axis, None)),
-                check_vma=False,
             ),
             donate_argnums=(0, 1),
         )

@@ -180,7 +180,7 @@ def _sharded_fns(mesh: Mesh, axis: str):
     cached = _JIT_CACHE.get(key)
     if cached is not None:
         return cached
-    from jax import shard_map
+    from fluidframework_tpu.parallel.mesh import compat_shard_map
 
     n = mesh.devices.size
     n_lanes = len(SegmentState._fields)
@@ -201,16 +201,16 @@ def _sharded_fns(mesh: Mesh, axis: str):
         return SegmentState(*[x[None] for x in out])
 
     step_fn = jax.jit(
-        shard_map(
+        compat_shard_map(
             step, mesh=mesh, in_specs=(state_spec, P()),
-            out_specs=state_spec, check_vma=False,
+            out_specs=state_spec,
         ),
         donate_argnums=(0,),
     )
     compact_fn = jax.jit(
-        shard_map(
+        compat_shard_map(
             compact_shard, mesh=mesh, in_specs=(state_spec,),
-            out_specs=state_spec, check_vma=False,
+            out_specs=state_spec,
         ),
         donate_argnums=(0,),
     )

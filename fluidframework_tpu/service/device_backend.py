@@ -36,7 +36,7 @@ from fluidframework_tpu.ops.segment_state import (
     materialize,
 )
 from fluidframework_tpu.parallel.fleet import DocFleet
-from fluidframework_tpu.protocol.constants import F_SEQ, OP_WIDTH
+from fluidframework_tpu.protocol.constants import F_ARG, F_SEQ, OP_WIDTH
 from fluidframework_tpu.utils import pow2_at_least as _pow2_at_least
 
 ChannelKey = Tuple[str, str]  # (doc_id, channel address)
@@ -56,12 +56,17 @@ class DeviceFleetBackend:
         max_capacity: int = 1 << 16,
         sharded_overflow: bool = False,
         mesh=None,
+        kernel: str = "auto",
     ):
         # ``mesh``: shard every fleet pool's document axis over a
         # jax.sharding.Mesh — the serving deployment shape (per-partition
         # lambdas shard documents across a TPU mesh, SURVEY.md:13-15).
+        # ``kernel`` passes through to the fleet: a mesh fleet rides the
+        # fused Pallas engine per shard under shard_map on TPU ("auto"),
+        # exactly like the single-device fleet.
         self.fleet = DocFleet(
-            0, capacity, max_capacity=max_capacity, mesh=mesh
+            0, capacity, max_capacity=max_capacity, mesh=mesh,
+            kernel=kernel,
         )
         self.max_batch = max_batch
         self.compact_every = compact_every
@@ -107,14 +112,15 @@ class DeviceFleetBackend:
         # jit cache is global, so later backends skip even the throwaway
         # dispatches.
         key = (
-            capacity, max_capacity,
+            capacity, max_capacity, kernel,
             None if mesh is None else tuple(d.id for d in mesh.devices.flat),
         )
         if key not in _WARMED:
             _WARMED.add(key)
             for slots in (1, 2, 4):
                 warm = DocFleet(
-                    slots, capacity, max_capacity=max_capacity, mesh=mesh
+                    slots, capacity, max_capacity=max_capacity, mesh=mesh,
+                    kernel=kernel,
                 )
                 warm.apply(np.zeros((slots, 8, OP_WIDTH), np.int32))
                 # The serving path flushes through the SPARSE staging +
@@ -173,18 +179,30 @@ class DeviceFleetBackend:
         protocol/opframe.py) — same replay-idempotence contract as
         :meth:`enqueue`, vectorized: the frame's contiguous seq run is
         truncated at the channel watermark in one comparison, insert
-        payloads land in the channel dict in one update."""
+        payloads land in the channel dict in one update. All-insert
+        frames (the steady-state stream) skip the insert-mask gather:
+        texts already align 1:1 with rows."""
         key = (doc_id, frame.address)
-        idx = self.ensure(doc_id, frame.address)
-        water = max(
-            self.applied_seq[key], self._buffered_seq.get(key, 0)
-        )
+        idx = self._index.get(key)
+        if idx is None:
+            idx = self.ensure(doc_id, frame.address)
+        rows = frame.rows
+        texts = frame.texts
+        n = rows.shape[0]
+        water = self.applied_seq[key]
+        bw = self._buffered_seq.get(key, 0)
+        if bw > water:
+            water = bw
         skip = water - frame.first_seq + 1
-        rows = frame.rows if skip <= 0 else frame.rows[skip:]
-        if rows.shape[0] == 0:
-            return
-        origs, texts = frame.insert_payloads()
+        if skip > 0:
+            rows = rows[skip:]
+            if rows.shape[0] == 0:
+                return
         if texts:
+            if len(texts) == n:
+                origs = frame.rows[:, F_ARG]
+            else:
+                origs, texts = frame.insert_payloads()
             self.payloads[key].update(zip(origs.tolist(), texts))
         self._buffered_seq[key] = int(rows[-1, F_SEQ])
         self._buffers.setdefault(idx, []).append(rows)
@@ -232,49 +250,92 @@ class DeviceFleetBackend:
                 scans = self.fleet.finish_scan(self._scan_token)
                 self._scan_token = None
                 self._consume_scan(scans, newly_errored)
-            take: Dict[int, np.ndarray] = {}
+            # Staging is vectorized end-to-end: a per-channel Python loop
+            # here was ~30% of the serving round's host wall at 10k+ busy
+            # channels. Chunk limits come from one placement-cap gather,
+            # and the boxcar assembles with one np.stack when every
+            # channel shipped the same row count (the round-shaped frame
+            # wire's common case).
+            t0 = time.perf_counter()
+            buffers = self._buffers
+            n = len(buffers)
+            idxs = np.fromiter(buffers.keys(), np.int64, n)
+            rows_list = [
+                c[0] if len(c) == 1 else np.concatenate(c)
+                for c in buffers.values()
+            ]
+            lens = np.fromiter(
+                (r.shape[0] for r in rows_list), np.int64, n
+            )
+            # Fleet docs chunk to HALF their tier's promotion headroom:
+            # the promotion trigger is one boxcar stale, so two flushes
+            # of growth must fit between high_water and capacity
+            # (fleet.py's stated contract). Evicted/sharded docs
+            # (cap < 0) take the raw boxcar limit.
+            caps = self.fleet.doc_caps(idxs)
+            limits = np.minimum(
+                np.where(
+                    caps > 0,
+                    np.maximum(
+                        1,
+                        ((1 - self.fleet.high_water) * caps / 2).astype(
+                            np.int64
+                        ),
+                    ),
+                    self.max_batch,
+                ),
+                self.max_batch,
+            )
             rest: Dict[int, List[np.ndarray]] = {}
-            for idx, chunks in self._buffers.items():
-                # Buffer entries are [k, OP_WIDTH] arrays (frames arrive
-                # whole); coalesce to one per channel for this round.
-                rows = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
-                # Fleet docs chunk to HALF their tier's promotion
-                # headroom: the promotion trigger is one boxcar stale, so
-                # two flushes of growth must fit between high_water and
-                # capacity (fleet.py's stated contract).
-                limit = self.max_batch
-                if idx not in self._sharded:
-                    cap = self.fleet.placement[idx][0]
-                    limit = min(
-                        limit,
-                        max(1, int((1 - self.fleet.high_water) * cap / 2)),
-                    )
-                take[idx] = rows[:limit]
-                if len(rows) > limit:
-                    rest[idx] = [rows[limit:]]
+            over = lens > limits
+            if over.any():
+                for i in np.flatnonzero(over):
+                    lim = int(limits[i])
+                    rest[int(idxs[i])] = [rows_list[i][lim:]]
+                    rows_list[i] = rows_list[i][:lim]
+                    lens[i] = lim
             self._buffers = rest
-            k = max(len(r) for r in take.values())
-            k = _pow2_at_least(max(k, 8))
-            sharded_rows: Dict[int, List[np.ndarray]] = {}
-            fleet_docs: List[int] = []
-            fleet_lists: List[List[np.ndarray]] = []
-            for idx, rows in take.items():
-                if idx in self._sharded:
-                    sharded_rows[idx] = rows
-                else:
-                    fleet_docs.append(idx)
-                    fleet_lists.append(rows)
-                key = self._keys[idx]
-                self.applied_seq[key] = max(
-                    self.applied_seq[key], int(rows[-1][F_SEQ])
+            keys = self._keys
+            applied = self.applied_seq
+            since = self.ops_since_summary
+            total_rows = 0
+            for idx, rows in zip(idxs.tolist(), rows_list):
+                key = keys[idx]
+                seq = int(rows[-1, F_SEQ])
+                if seq > applied[key]:
+                    applied[key] = seq
+                since[key] += rows.shape[0]
+                total_rows += rows.shape[0]
+            self.ops_applied += total_rows
+            if self._sharded:
+                shard_sel = np.fromiter(
+                    (int(i) in self._sharded for i in idxs), bool, n
                 )
-                self.ops_since_summary[key] += len(rows)
-                self.ops_applied += len(rows)
-            if fleet_docs:
-                t0 = time.perf_counter()
-                ops_b = np.zeros((len(fleet_docs), k, OP_WIDTH), np.int32)
-                for j, rows in enumerate(fleet_lists):
-                    ops_b[j, : len(rows)] = rows
+                fleet_sel = np.flatnonzero(~shard_sel)
+                sharded_rows = {
+                    int(idxs[i]): rows_list[i]
+                    for i in np.flatnonzero(shard_sel)
+                }
+            else:
+                fleet_sel = np.arange(n)
+                sharded_rows = {}
+            k = _pow2_at_least(max(int(lens.max()), 8))
+            if fleet_sel.size:
+                fleet_docs = idxs[fleet_sel]
+                fl = (
+                    rows_list
+                    if fleet_sel.size == n
+                    else [rows_list[i] for i in fleet_sel]
+                )
+                flens = lens[fleet_sel]
+                lmax = int(flens.max())
+                if int(flens.min()) == lmax:
+                    ops_b = np.zeros((len(fl), k, OP_WIDTH), np.int32)
+                    ops_b[:, :lmax] = np.stack(fl)
+                else:
+                    ops_b = np.zeros((len(fl), k, OP_WIDTH), np.int32)
+                    for j, rows in enumerate(fl):
+                        ops_b[j, : rows.shape[0]] = rows
                 t1 = time.perf_counter()
                 self.fleet.apply_sparse(fleet_docs, ops_b)
                 t2 = time.perf_counter()
@@ -282,6 +343,8 @@ class DeviceFleetBackend:
                 dispatch_s += (t2 - t1) - self.fleet.last_routing_s
                 staged_rows += ops_b.shape[0] * k
                 self._scan_token = self.fleet.begin_scan()
+            else:
+                staging_s += time.perf_counter() - t0
             self._flushes += 1
             compact_now = self._flushes % self.compact_every == 0
             for idx, rows in sharded_rows.items():

@@ -46,6 +46,19 @@ RAW_TOPIC = "rawdeltas"
 DELTAS_TOPIC = "deltas"
 SIGNALS_TOPIC = "signals"
 
+# Cached read-only aranges: frame stamping runs per frame on the serving
+# path, and np.arange per call is measurable at 10k+ frames/round.
+_ARANGES: Dict[int, np.ndarray] = {}
+
+
+def _arange(n: int) -> np.ndarray:
+    a = _ARANGES.get(n)
+    if a is None:
+        a = np.arange(n, dtype=np.int32)
+        a.setflags(write=False)
+        _ARANGES[n] = a
+    return a
+
 
 # ---------------------------------------------------------------------------
 # Framework
@@ -59,7 +72,16 @@ class PartitionLambda:
     live mutable structures): the checkpoint store keeps it as-is — a
     defensive deepcopy per checkpoint was the single largest cost on the
     serving pipeline at fleet scale. ``restore`` likewise must not
-    mutate the state object it is given."""
+    mutate the state object it is given.
+
+    ``wants``: optional frozenset of record types (``value["t"]``) the
+    lambda acts on. The runner drops non-matching records BEFORE the
+    handler call — a consumer that would return ``[]`` anyway must not
+    pay Python dispatch (or per-doc demux and dirty-marking) per record
+    on the serving path. None = every record (also required for topics
+    whose records carry no ``t`` key)."""
+
+    wants: Optional[frozenset] = None
 
     def handler(self, key: str, value: Any) -> List[Tuple[str, str, Any]]:
         raise NotImplementedError
@@ -70,6 +92,21 @@ class PartitionLambda:
     @classmethod
     def restore(cls, state: Any) -> "PartitionLambda":
         raise NotImplementedError
+
+
+class BatchHandlerError(Exception):
+    """Raised by ``handler_batch`` when a record mid-chunk fails: carries
+    the outputs already produced and how many records completed, so the
+    runner can emit them and commit the offset up to the failure —
+    EXACTLY the per-record loop's crash semantics. Without this, outputs
+    of records the lambdas already mutated state for (e.g. deli tickets)
+    would be discarded while their replay dedup-drops — lost ops."""
+
+    def __init__(self, outputs, n_ok: int, cause: BaseException):
+        super().__init__(f"batch handler failed after {n_ok} records")
+        self.outputs = outputs
+        self.n_ok = n_ok
+        self.cause = cause
 
 
 class CheckpointStore:
@@ -117,10 +154,15 @@ class DocumentLambda(PartitionLambda):
     # is quadratic in fleet size on the serving path).
     incremental_state = True
 
-    def __init__(self, per_doc_factory: Callable[[str, Any], PartitionLambda]):
+    def __init__(
+        self,
+        per_doc_factory: Callable[[str, Any], PartitionLambda],
+        wants: Optional[frozenset] = None,
+    ):
         self._factory = per_doc_factory
         self._docs: Dict[str, PartitionLambda] = {}
         self._dirty: set = set()
+        self.wants = wants
 
     def doc(self, doc_id: str) -> PartitionLambda:
         if doc_id not in self._docs:
@@ -130,6 +172,35 @@ class DocumentLambda(PartitionLambda):
     def handler(self, key: str, value: Any) -> List[Tuple[str, str, Any]]:
         self._dirty.add(key)
         return self.doc(key).handler(key, value)
+
+    def handler_batch(self, recs) -> List[Tuple[str, str, Any]]:
+        """One read chunk through the router in a single call: the wants
+        filter, dirty-marking, and demux run as one tight loop instead of
+        per-record dispatch through the runner (documentLambda.ts routes
+        per message; at 10k+ frames/round the layers ARE the cost).
+        A failing record raises :class:`BatchHandlerError` carrying the
+        completed prefix's outputs, preserving the per-record loop's
+        output-before-commit crash contract."""
+        out: List[Tuple[str, str, Any]] = []
+        docs = self._docs
+        dirty = self._dirty
+        wants = self.wants
+        for i, rec in enumerate(recs):
+            value = rec.value
+            if wants is not None and value.get("t") not in wants:
+                continue
+            key = rec.key
+            lam = docs.get(key)
+            if lam is None:
+                lam = docs[key] = self._factory(key, None)
+            dirty.add(key)
+            try:
+                res = lam.handler(key, value)
+            except Exception as e:
+                raise BatchHandlerError(out, i, e) from e
+            if res:
+                out.extend(res)
+        return out
 
     def state(self) -> Any:
         dirty, self._dirty = self._dirty, set()
@@ -174,25 +245,66 @@ class PartitionRunner:
             self._since_checkpoint[p] = 0
 
     def pump(self) -> int:
-        """Drain every partition's backlog; returns records processed."""
+        """Drain every partition's backlog; returns records processed.
+
+        Lambdas exposing ``handler_batch`` consume each read chunk in one
+        call (outputs flushed with one boxcar append per chunk); others
+        run per-record with the ``wants`` type filter applied here.
+        Offsets advance per chunk — output-before-commit order is
+        preserved, so a crash replays at most one chunk (at-least-once,
+        same contract as the per-record loop, coarser granularity)."""
         n = 0
         for p in range(self.log.n_partitions):
             lam = self._lambdas[p]
+            batch = getattr(lam, "handler_batch", None)
+            wants = getattr(lam, "wants", None)
             while True:
-                recs = self.log.read(self.topic, p, self._offsets[p], limit=64)
+                recs = self.log.read(
+                    self.topic, p, self._offsets[p], limit=256
+                )
                 if not recs:
                     break
-                for rec in recs:
-                    for out_topic, out_key, out_value in lam.handler(
-                        rec.key, rec.value
-                    ):
-                        self.log.send(out_topic, out_key, out_value)
-                    self._offsets[p] = rec.offset + 1
-                    n += 1
-                    self._since_checkpoint[p] += 1
-                    if self._since_checkpoint[p] >= self.checkpoint_every:
-                        self.checkpoint(p)
+                if batch is not None:
+                    try:
+                        outs = batch(recs)
+                    except BatchHandlerError as be:
+                        # Commit the completed prefix exactly as the
+                        # per-record loop would have, then surface the
+                        # failing record's error.
+                        if be.outputs:
+                            self._emit(be.outputs)
+                        if be.n_ok:
+                            self._offsets[p] = recs[be.n_ok - 1].offset + 1
+                            self._since_checkpoint[p] += be.n_ok
+                        raise be.cause
+                    if outs:
+                        self._emit(outs)
+                else:
+                    for rec in recs:
+                        value = rec.value
+                        if wants is not None and value.get("t") not in wants:
+                            continue
+                        outs = lam.handler(rec.key, value)
+                        if outs:
+                            self._emit(outs)
+                self._offsets[p] = recs[-1].offset + 1
+                n += len(recs)
+                self._since_checkpoint[p] += len(recs)
+                if self._since_checkpoint[p] >= self.checkpoint_every:
+                    self.checkpoint(p)
         return n
+
+    def _emit(self, outs: List[Tuple[str, str, Any]]) -> None:
+        by_topic: Dict[str, List[Tuple[str, Any]]] = {}
+        for out_topic, out_key, out_value in outs:
+            by_topic.setdefault(out_topic, []).append((out_key, out_value))
+        for topic, entries in by_topic.items():
+            send_batch = getattr(self.log, "send_batch", None)
+            if send_batch is not None:
+                send_batch(topic, entries)
+            else:  # minimal log impls (native binding) only expose send
+                for key, value in entries:
+                    self.log.send(topic, key, value)
 
     def checkpoint(self, partition: Optional[int] = None) -> None:
         parts = range(self.log.n_partitions) if partition is None else [partition]
@@ -233,22 +345,22 @@ class DeliDocLambda(PartitionLambda):
         self.sequencer = DocumentSequencer(doc_id, checkpoint)
 
     def state(self) -> dict:
-        cp = self.sequencer.checkpoint()
         return {
-            "sequencer": {
-                "sequence_number": cp.sequence_number,
-                "minimum_sequence_number": cp.minimum_sequence_number,
-                "clients": cp.clients,
-                "next_slot": cp.next_slot,
-                "free_slots": cp.free_slots,
-                "connection_count": cp.connection_count,
-            },
+            "sequencer": self.sequencer.checkpoint_dict(),
             "signals": self._signal_counter,
             "signal_basis": dict(self._signal_basis),
         }
 
     def handler(self, key: str, value: dict) -> List[Tuple[str, str, Any]]:
         t = value["t"]
+        if t == "opframe":
+            # Hot path: no per-record metric object — a Lumber allocation
+            # per frame was measurable serving-path overhead; sampled op
+            # tracing (alfred's 1-in-N stamp) remains the observability
+            # story for the data plane, metrics cover the control plane.
+            return self._handle_frame(key, value)
+        if t == "op":
+            return self._handle(key, value, t)
         metric = Lumberjack.new_metric(
             LumberEventName.DeliHandler,
             {"tenantId": "local", "documentId": self.doc_id, "recordType": t},
@@ -321,7 +433,10 @@ class DeliDocLambda(PartitionLambda):
     def _handle_frame(self, key: str, value: dict) -> List[Tuple[str, str, Any]]:
         """Ticket a batched binary op frame (protocol/opframe.py) in one
         vectorized call and emit the sequenced frame as ONE deltas record
-        — the wire path that keeps per-op Python off the serving path."""
+        — the wire path that keeps per-op Python off the serving path.
+        The whole-frame-valid case (no dup prefix, no trailing nack — the
+        steady-state stream) stamps with cached aranges and reuses the
+        frame's texts tuple without the per-op insert scan."""
         from fluidframework_tpu.protocol.constants import (
             F_CLIENT, F_MSN, F_REF, F_SEQ, F_TYPE, OP_INSERT,
         )
@@ -330,8 +445,9 @@ class DeliDocLambda(PartitionLambda):
 
         client = value["client"]
         frame = value["frame"]
+        fr = frame.rows
         res = self.sequencer.ticket_frame(
-            client, frame.csn0, frame.n, frame.rows[:, F_REF]
+            client, frame.csn0, frame.n, fr[:, F_REF]
         )
         if res is None:
             return []
@@ -339,16 +455,22 @@ class DeliDocLambda(PartitionLambda):
             return [(DELTAS_TOPIC, key, {"t": "nack", "client": client,
                                          "nack": res})]
         assert isinstance(res, FrameTicket)
-        rows = np.array(frame.rows[res.drop : res.drop + res.m], np.int32)
-        rows[:, F_SEQ] = res.seq0 + np.arange(res.m, dtype=np.int32)
+        whole = res.drop == 0 and res.m == frame.n
+        rows = np.array(fr if whole else fr[res.drop : res.drop + res.m],
+                        np.int32)
+        rows[:, F_SEQ] = res.seq0 + _arange(res.m)
         rows[:, F_MSN] = res.msn
         rows[:, F_CLIENT] = client
-        ins = frame.rows[:, F_TYPE] == OP_INSERT
-        t_lo = int(np.count_nonzero(ins[: res.drop]))
-        t_hi = int(np.count_nonzero(ins[: res.drop + res.m]))
+        if whole:
+            texts = frame.texts
+        else:
+            ins = fr[:, F_TYPE] == OP_INSERT
+            t_lo = int(np.count_nonzero(ins[: res.drop]))
+            t_hi = int(np.count_nonzero(ins[: res.drop + res.m]))
+            texts = frame.texts[t_lo:t_hi]
         sf = SeqFrame(
-            frame.address, client, frame.csn0 + res.drop, rows,
-            frame.texts[t_lo:t_hi], res.timestamp,
+            frame.address, client, frame.csn0 + res.drop, rows, texts,
+            res.timestamp,
         )
         out: List[Tuple[str, str, Any]] = [
             (DELTAS_TOPIC, key, {"t": "seqframe", "frame": sf})
@@ -422,24 +544,128 @@ def stored_message(v) -> SequencedDocumentMessage:
     return v[0].message(v[1]) if isinstance(v, tuple) else v
 
 
-class ScriptoriumLambda(PartitionLambda):
-    """Idempotent insert of sequenced ops keyed by (doc, seq). Frame
-    records store one ``(frame, i)`` pointer per covered seq — readers
-    expand through :func:`stored_message`."""
+class DocOpLog:
+    """One document's durable op index (the Mongo deltas collection).
 
-    def __init__(self, ops_store: Dict[str, Dict[int, SequencedDocumentMessage]]):
+    Point ops (the JSON wire, system messages) store per seq; a sequenced
+    FRAME stores ONCE — one list append for its whole contiguous seq run,
+    not a dict write per covered op (at 10k+ frames/round the per-op
+    writes were the entire scriptorium stage cost). Reads resolve frame
+    seqs by bisect and expand lazily through :func:`stored_message`, so
+    the read-time shape is unchanged: this class keeps the seq-keyed
+    mapping surface (iter/len/contains/getitem/items) the service's
+    delta readers and tests already use.
+
+    Idempotence under at-least-once replay: deli re-produces identical
+    frames, and per-doc partition order means a replayed frame's run can
+    never extend past the stored head — anything at or below it drops.
+    """
+
+    __slots__ = ("ops", "frames", "_starts", "head")
+
+    def __init__(self):
+        self.ops: Dict[int, SequencedDocumentMessage] = {}
+        self.frames: list = []  # ascending, non-overlapping seq runs
+        self._starts: List[int] = []  # frames[i].first_seq (bisect key)
+        self.head = 0  # highest stored seq (O(1) doc_head probe)
+
+    def add_msg(self, msg: SequencedDocumentMessage) -> None:
+        seq = msg.sequence_number
+        self.ops[seq] = msg
+        if seq > self.head:
+            self.head = seq
+
+    def add_frame(self, frame) -> None:
+        if frame.last_seq <= self.head:
+            return  # replay duplicate: identical re-production, drop
+        self.frames.append(frame)
+        self._starts.append(frame.first_seq)
+        self.head = frame.last_seq
+
+    def _frame_entry(self, seq: int):
+        import bisect
+
+        i = bisect.bisect_right(self._starts, seq) - 1
+        if i >= 0:
+            f = self.frames[i]
+            if seq <= f.last_seq:
+                return (f, seq - f.first_seq)
+        return None
+
+    # -- the seq-keyed mapping surface ----------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.ops) + sum(f.n for f in self.frames)
+
+    def __iter__(self):
+        yield from self.ops
+        for f in self.frames:
+            yield from range(f.first_seq, f.last_seq + 1)
+
+    def __contains__(self, seq) -> bool:
+        return seq in self.ops or self._frame_entry(seq) is not None
+
+    def __getitem__(self, seq):
+        m = self.ops.get(seq)
+        if m is not None:
+            return m
+        entry = self._frame_entry(seq)
+        if entry is None:
+            raise KeyError(seq)
+        return entry
+
+    def get(self, seq, default=None):
+        m = self.ops.get(seq)
+        if m is not None:
+            return m
+        entry = self._frame_entry(seq)
+        return default if entry is None else entry
+
+    def items(self):
+        yield from self.ops.items()
+        for f in self.frames:
+            s0 = f.first_seq
+            for i in range(f.n):
+                yield s0 + i, (f, i)
+
+    def keys(self):
+        return iter(self)
+
+
+class ScriptoriumLambda(PartitionLambda):
+    """Idempotent insert of sequenced ops keyed by (doc, seq): one
+    :class:`DocOpLog` per document, frames stored whole."""
+
+    wants = frozenset({"seq", "seqframe"})
+
+    def __init__(self, ops_store: Dict[str, DocOpLog]):
         self.ops_store = ops_store
+
+    def _doc(self, key: str) -> DocOpLog:
+        log = self.ops_store.get(key)
+        if log is None:
+            log = self.ops_store[key] = DocOpLog()
+        return log
 
     def handler(self, key: str, value: dict) -> List[Tuple[str, str, Any]]:
         if value["t"] == "seq":
-            msg = value["msg"]
-            self.ops_store.setdefault(key, {})[msg.sequence_number] = msg
+            self._doc(key).add_msg(value["msg"])
         elif value["t"] == "seqframe":
-            frame = value["frame"]
-            store = self.ops_store.setdefault(key, {})
-            s0 = frame.first_seq
-            for i in range(frame.n):
-                store[s0 + i] = (frame, i)
+            self._doc(key).add_frame(value["frame"])
+        return []
+
+    def handler_batch(self, recs) -> List[Tuple[str, str, Any]]:
+        store = self.ops_store
+        for rec in recs:
+            value = rec.value
+            t = value.get("t")
+            if t == "seqframe":
+                log = store.get(rec.key)
+                if log is None:
+                    log = store[rec.key] = DocOpLog()
+                log.add_frame(value["frame"])
+            elif t == "seq":
+                self._doc(rec.key).add_msg(value["msg"])
         return []
 
     def state(self) -> Any:
@@ -453,6 +679,8 @@ class ScriptoriumLambda(PartitionLambda):
 class BroadcasterLambda(PartitionLambda):
     """Delivers sequenced ops to every connection in the document's room,
     dropping anything a connection already saw (idempotent under replay)."""
+
+    wants = frozenset({"seq", "seqframe", "nack"})
 
     def __init__(self, rooms: Dict[str, list]):
         self.rooms = rooms

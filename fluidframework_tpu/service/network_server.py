@@ -101,6 +101,11 @@ class FluidNetworkServer:
         self.port = port
         self.tenants = tenants
         self._sessions: List[_Session] = []
+        # Binary frame-wire counters (ingress/egress OP_BINARY frames):
+        # e2e tests assert the batched wire was actually taken.
+        self.frames_received = 0
+        self.frames_expanded = 0  # ingress frames per-op fallback-expanded
+        self.frames_delivered = 0
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
@@ -377,11 +382,13 @@ class FluidNetworkServer:
 
         if session.conn is None:
             return
+        self.frames_received += 1
         frame = OpFrame.decode(payload)
         submit = getattr(session.conn, "submit_frame", None)
         if submit is not None:
             submit(frame)
         else:
+            self.frames_expanded += 1
             # Service without a frame front door (e.g. the in-memory
             # local orderer): fall back to per-op submits — the wire
             # stays usable everywhere, just without the batched ticket.
@@ -555,6 +562,7 @@ class FluidNetworkServer:
                     s.writer.write(
                         wsproto.encode_frame(wsproto.OP_BINARY, m.encode())
                     )
+                    self.frames_delivered += 1
             sigs, s.conn.signals[:] = list(s.conn.signals), []
             for sig in sigs:
                 self._send(

@@ -332,12 +332,41 @@ class DocumentSequencer:
                 f"clientSequenceNumber gap (expected {entry.client_seq + 1})",
                 client_sequence_number=csn0 + drop,
             )
-        # Per-op semantics, computed as one pass: op i is stale against
-        # the MSN established by op i-1 (the freshly advanced floor per-op
-        # ticket() checks), and msn_i = max(floor, min(others_min,
-        # refs[i])) never regresses. A plain Python loop beats numpy well
-        # past typical frame sizes (array overhead ~20µs/frame dominates
-        # the serving pipeline's deli stage at n<=64).
+        # Fast path — the steady-state serving stream: no dup prefix and
+        # every op in the frame shares one refSeq (a client-turn batch
+        # authored against one head). MSN per op is then a constant:
+        # max(floor, min(r0, others_min)), no per-op pass at all.
+        now = time.time()
+        if drop == 0:
+            r0 = int(refs[0])
+            if r0 == int(refs[-1]) and r0 >= self.min_seq and (
+                n < 3 or (np.asarray(refs) == r0).all()
+            ):
+                others_min = None
+                for c in self.clients.values():
+                    if c.client_id != client_id and (
+                        others_min is None or c.ref_seq < others_min
+                    ):
+                        others_min = c.ref_seq
+                floor = r0 if others_min is None else min(r0, others_min)
+                if floor < self.min_seq:
+                    floor = self.min_seq
+                entry.client_seq = csn0 + n - 1
+                entry.ref_seq = r0
+                entry.last_seen = now
+                seq0 = self.seq + 1
+                self.seq += n
+                self.min_seq = floor
+                return FrameTicket(
+                    drop=0, m=n, seq0=seq0,
+                    msn=np.full(n, floor, np.int32), timestamp=now,
+                )
+        # General path (per-op semantics in one pass): op i is stale
+        # against the MSN established by op i-1 (the freshly advanced
+        # floor per-op ticket() checks), and msn_i = max(floor,
+        # min(others_min, refs[i])) never regresses. A plain Python loop
+        # beats numpy well past typical frame sizes (array overhead
+        # ~20µs/frame dominates the deli stage at n<=64).
         others = [
             c.ref_seq for c in self.clients.values() if c.client_id != client_id
         ]
@@ -364,7 +393,7 @@ class DocumentSequencer:
         msn = np.asarray(msn_l, np.int32)
         entry.client_seq = csn0 + drop + m - 1
         entry.ref_seq = refs_l[m - 1]
-        entry.last_seen = time.time()
+        entry.last_seen = now
         seq0 = self.seq + 1
         self.seq += m
         self.min_seq = int(msn_l[-1])
@@ -376,7 +405,7 @@ class DocumentSequencer:
                 client_sequence_number=csn0 + drop + m,
             )
         return FrameTicket(drop=drop, m=m, seq0=seq0, msn=msn,
-                           timestamp=time.time(), trailing_nack=nack)
+                           timestamp=now, trailing_nack=nack)
 
     # -- internals ------------------------------------------------------------
 
@@ -403,12 +432,19 @@ class DocumentSequencer:
             timestamp=time.time(),
         )
 
+    def checkpoint_dict(self) -> dict:
+        """Durable state as a plain dict — the ONE serialization of the
+        sequencer (``checkpoint()`` wraps it; deli's hot-path checkpoint
+        uses it directly to skip the dataclass allocation per dirty doc).
+        Keys mirror :class:`SequencerCheckpoint`'s fields exactly."""
+        return {
+            "sequence_number": self.seq,
+            "minimum_sequence_number": self.min_seq,
+            "clients": [c.__dict__.copy() for c in self.clients.values()],
+            "next_slot": self._next_slot,
+            "free_slots": [list(x) for x in self._free_slots],
+            "connection_count": self._conn_count,
+        }
+
     def checkpoint(self) -> SequencerCheckpoint:
-        return SequencerCheckpoint(
-            sequence_number=self.seq,
-            minimum_sequence_number=self.min_seq,
-            clients=[c.__dict__.copy() for c in self.clients.values()],
-            next_slot=self._next_slot,
-            free_slots=[list(x) for x in self._free_slots],
-            connection_count=self._conn_count,
-        )
+        return SequencerCheckpoint(**self.checkpoint_dict())

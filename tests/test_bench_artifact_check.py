@@ -1,0 +1,72 @@
+"""The bench-artifact CI gate (tools/check_bench_artifact.py): committed
+round artifacts after r5 must carry the serving-path headline metrics."""
+
+import json
+import os
+import sys
+
+
+def _tool():
+    tools = os.path.join(os.path.dirname(__file__), "..", "tools")
+    sys.path.insert(0, tools)
+    try:
+        import check_bench_artifact
+    finally:
+        sys.path.remove(tools)
+    return check_bench_artifact
+
+
+def _write(tmp_path, name, tail_lines):
+    (tmp_path / name).write_text(
+        json.dumps({"n": 1, "rc": 0, "tail": "\n".join(tail_lines)})
+    )
+
+
+def test_missing_serving_metrics_fails(tmp_path):
+    cba = _tool()
+    _write(tmp_path, "BENCH_r06.json",
+           ['{"metric": "merge_ops_per_sec_per_chip", "value": 1}'])
+    assert cba.check(str(tmp_path)) == 1
+
+
+def test_complete_artifact_passes(tmp_path):
+    cba = _tool()
+    _write(tmp_path, "BENCH_r06.json", [json.dumps({
+        "metric": "merge_ops_per_sec_per_chip", "value": 1,
+        "pipeline_serving_ops_per_sec": 2,
+        "deli_scribe_e2e_ops_per_sec": 3,
+        "fleet_mesh_ops_per_sec": 4,
+    })])
+    assert cba.check(str(tmp_path)) == 0
+
+
+def test_metrics_may_span_multiple_record_lines(tmp_path):
+    cba = _tool()
+    _write(tmp_path, "BENCH_r07.json", [
+        "some non-json warning line",
+        '{"metric": "pipeline_serving_ops_per_sec", '
+        '"pipeline_serving_ops_per_sec": 2}',
+        '{"deli_scribe_e2e_ops_per_sec": 3}',
+        '{"fleet_mesh_ops_per_sec": 4}',
+    ])
+    assert cba.check(str(tmp_path)) == 0
+
+
+def test_newest_round_governs(tmp_path):
+    cba = _tool()
+    _write(tmp_path, "BENCH_r05.json", ['{"metric": "old"}'])
+    _write(tmp_path, "BENCH_r06.json", ['{"metric": "new"}'])
+    assert cba.check(str(tmp_path)) == 1  # r6 is newest and incomplete
+
+
+def test_pre_serving_rounds_exempt(tmp_path):
+    cba = _tool()
+    _write(tmp_path, "BENCH_r05.json", ['{"metric": "old"}'])
+    assert cba.check(str(tmp_path)) == 0
+
+
+def test_repo_root_artifacts_pass():
+    """The gate must hold on the repo as committed right now."""
+    cba = _tool()
+    root = os.path.join(os.path.dirname(__file__), "..")
+    assert cba.check(root) == 0

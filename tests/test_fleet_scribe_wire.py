@@ -153,7 +153,7 @@ def test_pack_blob_one_store_write_per_sweep():
     n, total = svc.summarize_dirty(threshold=1)
     assert n == svc.n_docs
     assert len(svc.store._backend._blobs) == writes_before + 1
-    handles = {svc._summary_handles[d][0] for d in range(svc.n_docs)}
+    handles = {svc._summary_handles[d][0][0] for d in range(svc.n_docs)}
     assert len(handles) == 1  # every doc points into the same pack
     for d in range(svc.n_docs):
         s = svc.latest_summary(d)

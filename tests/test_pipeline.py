@@ -207,3 +207,45 @@ class TestReservationManager:
         rm = ReservationManager(clock=lambda: now[0])
         assert rm.acquire("n", "d", 5) == 1
         assert rm.acquire("n", "d", 5) == 1
+
+
+def test_batch_pump_commits_prefix_outputs_on_midchunk_failure():
+    """A record failing mid-chunk must not discard the completed prefix's
+    outputs (deli tickets already advanced sequencer state — replay would
+    dedup-drop them: lost ops). The runner emits the prefix, commits its
+    offset, and resumes at the failing record."""
+    import pytest
+
+    from fluidframework_tpu.service.lambdas import (
+        DocumentLambda,
+        PartitionLambda,
+        PartitionRunner,
+    )
+    from fluidframework_tpu.service.queue import PartitionedLog
+
+    class Boom(PartitionLambda):
+        def __init__(self, doc_id):
+            self.doc_id = doc_id
+
+        def handler(self, key, value):
+            if value.get("t") == "boom":
+                raise RuntimeError("bad record")
+            return [("out", key, value["n"])]
+
+    log = PartitionedLog(1)
+    for i in range(5):
+        log.send("in", "d", {"t": "ok", "n": i})
+    log.send("in", "d", {"t": "boom"})
+    log.send("in", "d", {"t": "ok", "n": 5})
+    runner = PartitionRunner(
+        log, "in", "g",
+        lambda p, s: DocumentLambda(lambda d, _s: Boom(d)),
+    )
+    with pytest.raises(RuntimeError):
+        runner.pump()
+    assert [r.value for r in log.read("out", 0, 0)] == [0, 1, 2, 3, 4]
+    assert runner._offsets[0] == 5
+    # Re-pump fails on the SAME record again — the prefix is not replayed.
+    with pytest.raises(RuntimeError):
+        runner.pump()
+    assert [r.value for r in log.read("out", 0, 0)] == [0, 1, 2, 3, 4]
