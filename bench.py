@@ -447,6 +447,25 @@ def serving_benchmarks(on_tpu: bool) -> dict:
         out.update(fleet_mesh_comparison(on_tpu))
     except Exception as e:  # noqa: BLE001
         out["serving_error_fleet_mesh"] = repr(e)[:500]
+    try:
+        import bench_configs as BC
+
+        # Config 3c-moves: move-bearing SharedTree commit streams through
+        # the production EM device path (r7: mout/min are device-native).
+        # The headline is the device-ridden fraction at the 5% move mix —
+        # the r7 acceptance number, parity-asserted inside the config.
+        rec3m = BC.config3c_em_kernel_concurrent(
+            n_docs=256 if on_tpu else 8,
+            n_commits=256 if on_tpu else 32,
+            scripts=8 if on_tpu else 4,
+            wave=128 if on_tpu else 16,
+            move_prob=0.05,
+        )
+        out["tree_moves_device_fraction"] = rec3m["device_fraction"]
+        out["tree_moves_em_edits_per_sec"] = rec3m["value"]
+        out["tree_moves_commit_fraction"] = rec3m["move_commit_fraction"]
+    except Exception as e:  # noqa: BLE001
+        out["serving_error_tree_moves"] = repr(e)[:500]
     return out
 
 

@@ -25,6 +25,7 @@ import numpy as np
 from fluidframework_tpu.models.shared_map import SharedMap
 from fluidframework_tpu.models.shared_string import SharedString
 from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.tree.shared_tree import SharedTree
 
 ALPHABET = "abcdefghijklmnopqrstuvwxyz"
 
@@ -44,6 +45,12 @@ class LoadProfile:
     flush_every: int = 3
     process_every: int = 5
     string_weight: float = 0.7  # vs map ops
+    # Probability an op targets a SharedTree channel instead (r7): the
+    # tree mix includes first-class MOVE edits (mout/min on the wire), so
+    # the load envelope exercises the device-native move path and its
+    # rebase/convergence under faults — not just string/map traffic.
+    tree_weight: float = 0.0
+    tree_move_weight: float = 0.35  # of tree ops, how many are moves
     doc_id: str = "load-doc"
 
 
@@ -58,6 +65,9 @@ class LoadReport:
     final_text_len: int = 0
     texts: list = field(default_factory=list)  # per-replica, for divergence triage
     annotations: list = field(default_factory=list)
+    tree_ops_submitted: int = 0
+    tree_moves_submitted: int = 0
+    trees: list = field(default_factory=list)  # per-replica tree views
 
     @property
     def ops_per_sec(self) -> float:
@@ -81,19 +91,44 @@ class LoadRunner:
         report = LoadReport()
         t0 = time.monotonic()
 
+        def channels():
+            chans = [SharedString("text"), SharedMap("map")]
+            if p.tree_weight > 0:
+                chans.append(SharedTree("tree"))
+            return tuple(chans)
+
         runtimes: List[ContainerRuntime] = [
-            ContainerRuntime(
-                self._svc_for(i),
-                p.doc_id,
-                channels=(SharedString("text"), SharedMap("map")),
-            )
+            ContainerRuntime(self._svc_for(i), p.doc_id, channels=channels())
             for i in range(p.n_clients)
         ]
         for rt in runtimes:
             rt.on_nack_count = 0
         offline_until: dict = {}  # runtime index -> step to reconnect at
 
+        def one_tree_op(rt: ContainerRuntime) -> None:
+            t = rt.get_channel("tree")
+            n = len(t.get())
+            report.tree_ops_submitted += 1
+            if n >= 4 and rng.random() < p.tree_move_weight:
+                i0 = int(rng.integers(0, n - 1))
+                cnt = int(rng.integers(1, min(3, n - i0) + 1))
+                dest = int(rng.integers(0, n - cnt + 1))
+                t.move_nodes(i0, cnt, dest)
+                report.tree_moves_submitted += 1
+            elif n > 12 and rng.random() < 0.5:
+                i0 = int(rng.integers(0, n - 1))
+                t.delete_nodes(i0, min(int(rng.integers(1, 3)), n - i0))
+            else:
+                pos = int(rng.integers(0, n + 1))
+                t.insert_nodes(
+                    pos, [int(rng.integers(0, 1000))
+                          for _ in range(int(rng.integers(1, 3)))]
+                )
+
         def one_op(rt: ContainerRuntime) -> None:
+            if p.tree_weight > 0 and rng.random() < p.tree_weight:
+                one_tree_op(rt)
+                return
             s = rt.get_channel("text")
             length = len(s.get_text())
             if rng.random() < p.string_weight:
@@ -171,10 +206,17 @@ class LoadRunner:
         ]
         report.texts = texts
         report.annotations = annos
+        trees = (
+            [rt.get_channel("tree").get() for rt in runtimes]
+            if p.tree_weight > 0
+            else []
+        )
+        report.trees = trees
         report.converged = (
             all(t == texts[0] for t in texts)
             and all(a == annos[0] for a in annos)
             and all(m == maps[0] for m in maps)
+            and all(t == trees[0] for t in trees)
         )
         report.final_text_len = len(texts[0])
         report.nacks = sum(len(rt.connection.nacks) for rt in runtimes)

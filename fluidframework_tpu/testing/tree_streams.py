@@ -28,12 +28,15 @@ def host_trunk(commits):
     return state
 
 
-def gen_streams(rng, n_docs, n_commits, n_sessions, W, Lc, max_ins=16):
+def gen_streams(
+    rng, n_docs, n_commits, n_sessions, W, Lc, max_ins=16, move_prob=0.0
+):
     """Concurrent commit streams: sessions lag behind the head by < W and
     always cover their own previous commit (see device_trunk docstring).
     ``max_ins`` bounds inserted items per commit (dense pool capacity);
     document length is hard-bounded below Lc so every rebased/applied form
-    stays inside the fixed-shape IR."""
+    stays inside the fixed-shape IR. ``move_prob`` mixes in first-class
+    move commits (mout/min — the dense IR's move lanes, r7)."""
     all_commits = []
     for _d in range(n_docs):
         trunk_states = [[]]  # state after seq k
@@ -47,6 +50,27 @@ def gen_streams(rng, n_docs, n_commits, n_sessions, W, Lc, max_ins=16):
             lag = int(rng.integers(0, W - 1))
             ref = max(k - 1 - lag, last_of[s])
             view = trunk_states[ref]
+            if move_prob and len(view) >= 4 and rng.random() < move_prob:
+                i0 = int(rng.integers(0, len(view) - 1))
+                cnt = int(rng.integers(1, min(3, len(view) - i0) + 1))
+                dest = int(rng.integers(0, len(view) - cnt + 1))
+                cells = view[i0 : i0 + cnt]
+                if dest <= i0:
+                    c = [M.skip(dest), M.move_in(0, cnt),
+                         M.skip(i0 - dest), M.move_out(0, cells)]
+                else:
+                    c = [M.skip(i0), M.move_out(0, cells),
+                         M.skip(dest - i0), M.move_in(0, cnt)]
+                c = M.normalize(c)
+                ct = c
+                for seq_j in range(ref + 1, k):
+                    ct = M.rebase(ct, commits_trunk[seq_j - 1])
+                state = M.apply(state, ct)
+                trunk_states.append(list(state))
+                commits_trunk.append(ct)
+                commits.append((ref, c))
+                last_of[s] = k
+                continue
             c = []
             i = 0
             ins_left = max_ins
@@ -97,6 +121,10 @@ def to_device_batch(all_commits, Lc, Pc):
     dm = np.zeros((n_docs, C, Lc), np.int32)
     ic = np.zeros((n_docs, C, Lc + 1), np.int32)
     ii = np.zeros((n_docs, C, Pc), np.int32)
+    mid = np.zeros((n_docs, C, Lc), np.int32)
+    moff = np.zeros((n_docs, C, Lc), np.int32)
+    pmid = np.zeros((n_docs, C, Pc), np.int32)
+    poff = np.zeros((n_docs, C, Pc), np.int32)
     refs = np.zeros((n_docs, C), np.int32)
     seqs = np.broadcast_to(
         np.arange(1, C + 1, dtype=np.int32), (n_docs, C)
@@ -107,7 +135,11 @@ def to_device_batch(all_commits, Lc, Pc):
             dm[d, k] = np.asarray(dc.del_mask)
             ic[d, k] = np.asarray(dc.ins_cnt)
             ii[d, k] = np.asarray(dc.ins_ids)
+            mid[d, k] = np.asarray(dc.mov_id)
+            moff[d, k] = np.asarray(dc.mov_off)
+            pmid[d, k] = np.asarray(dc.pool_mid)
+            poff[d, k] = np.asarray(dc.pool_off)
             refs[d, k] = ref
-    return CommitBatch(dm, ic, ii, refs, seqs)
+    return CommitBatch(dm, ic, ii, refs, seqs, mid, moff, pmid, poff)
 
 

@@ -15,23 +15,42 @@ as dense device work:
   ring select — exact, because device-eligible commits are authored with
   no pending chain (their view IS trunk-at-ref);
 - the commit's positional marks decode against that view on device:
-  deleted ids become a multihot over the interned id universe ``U`` and
-  membership tests are one-hot matmuls (MXU work, no serialized gathers);
+  detached ids (deletes AND move-outs) become a multihot over the
+  interned id universe ``U`` and membership tests are one-hot matmuls
+  (MXU work, no serialized gathers);
 - each insert run resolves its anchor exactly as ``_transport`` does —
   nearest LEFT neighbor in the author's post-edit view that is present in
   the evolving output — via a prefix cumulative max over a membership
   mask, then inserts with the standard prefix-sum scatter.
 
+MOVE-BEARING commits ride this scan natively (r7). The EM algebra is the
+id-anchor transport, where a first-class move is detach + re-attach of
+the SAME cell ids (``marks.lower_moves`` — identity preserved, so
+id-anchored concurrent edits converge by the same argument): the encoder
+lowers ``mout`` slots into the dedicated ``mov_mask`` lane and ``min``
+attaches into insert runs whose pool ids ARE the moved cells (values are
+wire-known — the commit's own mout carried them), and the kernel folds
+``mov_mask`` into the detach multihot. The ring additionally carries a
+per-document MOVE-ID WATERMARK (highest seq of any move-bearing commit
+integrated, seeded from the manager's cross-batch watermark): when a
+commit's ref misses the retained ring AND the evicted range contains a
+move source (``ref < watermark``), the err lane reports it as a DISTINCT
+bit — ring-evicted move sources force host fallback explicitly, never
+silently, and the manager attributes the fallback to "moves" rather than
+the generic eviction bucket.
+
 Per-commit work is O(runs * Lc * U) matmul FLOPs with no data-dependent
-control flow; ``vmap`` batches documents. Commits whose ``ref`` fell off
-the ring (or is not a retained seq) flag the sticky err lane and the
-caller replays on the host path — same contract as the positional scan.
+control flow; ``vmap`` batches documents. The sticky ``err`` lane is a
+BITMASK: bit 0 = ref fell off the ring (or is not a retained seq), bit 1 =
+capacity overflow, bit 2 = the ring miss crossed a move-bearing commit
+(evicted move source). Any nonzero err means the caller replays the whole
+stream on the host path — same contract as the positional scan.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -45,14 +64,22 @@ from fluidframework_tpu.ops.tree_kernel import (
 
 _HIGHEST = jax.lax.Precision.HIGHEST
 
+# err bitmask lanes (sticky, per document).
+ERR_RING_MISS = 1  # commit ref older than every retained ring state
+ERR_CAPACITY = 2  # document outgrew the dense capacity mid-scan
+ERR_MOVE_EVICTED = 4  # the ring miss crossed a move-bearing commit
+
 
 class EmCommitBatch(NamedTuple):
     """C sequenced commits for one document, lowered for the EM scan.
 
     Marks are positional over the AUTHOR VIEW at ``ref`` (= trunk-at-ref
-    for device-eligible commits). ``run_*`` describe the commit's insert
-    runs in wire order: start position in the POST view, length, offset of
-    the run's first id in the ``ins_ids`` pool (-1 start = unused slot).
+    for device-eligible commits). ``run_*`` describe the commit's attach
+    runs (inserts AND move-ins) in wire order: start position in the POST
+    view, length, offset of the run's first id in the ``ins_ids`` pool
+    (-1 start = unused slot). ``mov_mask`` marks move-out slots — they
+    detach like deletes (the id-anchor lowering) but feed the move
+    watermark; None = move-free stream (zeros are materialized).
     """
 
     del_mask: jnp.ndarray  # int32[C, Lc]
@@ -63,6 +90,13 @@ class EmCommitBatch(NamedTuple):
     run_off: jnp.ndarray  # int32[C, R]
     ref: jnp.ndarray  # int32[C]
     seq: jnp.ndarray  # int32[C]
+    mov_mask: Optional[jnp.ndarray] = None  # int32[C, Lc]
+
+
+def _with_move_lane(commits: EmCommitBatch) -> EmCommitBatch:
+    if commits.mov_mask is not None:
+        return commits
+    return commits._replace(mov_mask=jnp.zeros_like(commits.del_mask))
 
 
 def _member(ids: jnp.ndarray, multihot: jnp.ndarray) -> jnp.ndarray:
@@ -92,8 +126,8 @@ def batched_em_trunk_scan(doc_ids, L, base_seq, commits: EmCommitBatch,
     )(doc_ids, L, base_seq, commits)
 
 
-@partial(jax.jit, static_argnums=(4,))
-def batched_em_trunk_scan_ring(ring_ids, ring_L, ring_seq,
+@partial(jax.jit, static_argnums=(5,))
+def batched_em_trunk_scan_ring(ring_ids, ring_L, ring_seq, mov_seq0,
                                commits: EmCommitBatch, U: int):
     """[N, W, Lc] PRE-SEEDED state rings, one per document: newest state
     (the current trunk) at slot W-1, older retained trunk states
@@ -102,10 +136,12 @@ def batched_em_trunk_scan_ring(ring_ids, ring_L, ring_seq,
     where each boxcar's early commits were authored against the previous
     boxcar's tail (a single-state ring forces all of those to the host
     path; production ingest is a sequence of boxcars, not one giant
-    catch-up)."""
+    catch-up). ``mov_seq0`` [N] seeds the per-document move-id watermark
+    (-1 = no move-bearing commit retained)."""
     return jax.vmap(
-        lambda ri, rl, rs, cb: em_trunk_scan_ring_one(ri, rl, rs, cb, U)
-    )(ring_ids, ring_L, ring_seq, commits)
+        lambda ri, rl, rs, mv, cb: em_trunk_scan_ring_one(ri, rl, rs, mv,
+                                                          cb, U)
+    )(ring_ids, ring_L, ring_seq, mov_seq0, commits)
 
 
 def em_trunk_scan_one(doc_ids, L, base_seq, commits: EmCommitBatch,
@@ -119,13 +155,16 @@ def em_trunk_scan_one(doc_ids, L, base_seq, commits: EmCommitBatch,
     ring_ids = jnp.zeros((W, Lc), jnp.int32).at[W - 1].set(doc_ids)
     ring_L = jnp.zeros(W, jnp.int32).at[W - 1].set(L)
     ring_seq = jnp.full(W, -1, jnp.int32).at[W - 1].set(base_seq)
-    return em_trunk_scan_ring_one(ring_ids, ring_L, ring_seq, commits, U)
+    return em_trunk_scan_ring_one(
+        ring_ids, ring_L, ring_seq, jnp.int32(-1), commits, U
+    )
 
 
-def em_trunk_scan_ring_one(ring_ids, ring_L, ring_seq,
+def em_trunk_scan_ring_one(ring_ids, ring_L, ring_seq, mov_seq0,
                            commits: EmCommitBatch, U: int):
     """Single-document EM trunk scan (see module docstring). The carry's
     document state starts as the ring's newest slot."""
+    commits = _with_move_lane(commits)
     W, Lc = ring_ids.shape
     Pc = commits.ins_ids.shape[-1]
     R = commits.run_start.shape[-1]
@@ -133,18 +172,32 @@ def em_trunk_scan_ring_one(ring_ids, ring_L, ring_seq,
     L = ring_L[W - 1]
 
     def step(carry, inp):
-        doc_ids, L, ring_ids, ring_L, ring_seq, err = carry
+        doc_ids, L, ring_ids, ring_L, ring_seq, mov_seq, err = carry
         ref = inp["ref"]
         seq = inp["seq"]
-        c = DenseChange(inp["del"], inp["ins"], inp["ids"])
+        # The lowered change: move-outs detach exactly like deletes (the
+        # id-anchor transport), so the positional lanes merge here.
+        detach = jnp.maximum(inp["del"], inp["mov"])
+        c = DenseChange(
+            detach, inp["ins"], inp["ids"],
+            jnp.zeros(Lc, jnp.int32), jnp.zeros(Lc, jnp.int32),
+            jnp.zeros(Pc, jnp.int32), jnp.zeros(Pc, jnp.int32),
+        )
+        has_move = jnp.max(inp["mov"]) > 0
 
         # 1. Author view at ref: the LATEST ring state with seq <= ref
         #    (document seqs are sparse — joins and other channels consume
         #    numbers — so trunk-at-ref is the newest trunk state at or
-        #    below it). Err when every retained state is newer (evicted).
+        #    below it). Err when every retained state is newer (evicted);
+        #    a distinct bit reports when the evicted span holds a move
+        #    source (the watermark check).
         mask = (ring_seq >= 0) & (ring_seq <= ref)
         best = jnp.max(jnp.where(mask, ring_seq, -1))
-        err = err | (best < 0).astype(jnp.int32)
+        miss = (best < 0).astype(jnp.int32)
+        err = err | miss * ERR_RING_MISS
+        err = err | (
+            miss * (mov_seq > ref).astype(jnp.int32) * ERR_MOVE_EVICTED
+        )
         hit = ((ring_seq == best) & mask).astype(jnp.int32)
         av_ids = jnp.sum(ring_ids * hit[:, None], axis=0)
         av_L = jnp.sum(ring_L * hit)
@@ -152,19 +205,23 @@ def em_trunk_scan_ring_one(ring_ids, ring_L, ring_seq,
         # 2. Post view: the commit applied to the author view.
         post_ids, _post_L = apply_change(av_ids, av_L, c)
 
-        # 3. Deleted ids -> multihot over U; drop them from the current
-        #    trunk (deletes are idempotent: absent ids match nothing).
+        # 3. Detached ids (deletes + move-outs) -> multihot over U; drop
+        #    them from the current trunk (detaches are idempotent: absent
+        #    ids match nothing — a moved id re-attaches via its run in
+        #    step 4, which is what makes a move device-native here).
         av_valid = jnp.arange(Lc) < av_L
-        del_vec = _multihot(av_ids, (c.del_mask > 0) & av_valid, U)
+        del_vec = _multihot(av_ids, (detach > 0) & av_valid, U)
         cur_valid = jnp.arange(Lc) < L
         cur_del = _member(doc_ids, del_vec) * cur_valid
         doc2, L2 = apply_change(
             doc_ids, L,
             DenseChange(cur_del, jnp.zeros(Lc + 1, jnp.int32),
-                        jnp.zeros(Pc, jnp.int32)),
+                        jnp.zeros(Pc, jnp.int32),
+                        jnp.zeros(Lc, jnp.int32), jnp.zeros(Lc, jnp.int32),
+                        jnp.zeros(Pc, jnp.int32), jnp.zeros(Pc, jnp.int32)),
         )
 
-        # 4. Insert runs in wire order, each anchored after the nearest
+        # 4. Attach runs in wire order, each anchored after the nearest
         #    left post-view neighbor present in the evolving output.
         def run_body(r, state):
             doc2, L2 = state
@@ -194,7 +251,11 @@ def em_trunk_scan_ring_one(ring_ids, ring_L, ring_seq,
             )
             new_doc, new_L = apply_change(
                 doc2, L2,
-                DenseChange(jnp.zeros(Lc, jnp.int32), ins_cnt, pool),
+                DenseChange(jnp.zeros(Lc, jnp.int32), ins_cnt, pool,
+                            jnp.zeros(Lc, jnp.int32),
+                            jnp.zeros(Lc, jnp.int32),
+                            jnp.zeros(Pc, jnp.int32),
+                            jnp.zeros(Pc, jnp.int32)),
             )
             keep = active & (length > 0)
             return (
@@ -203,24 +264,29 @@ def em_trunk_scan_ring_one(ring_ids, ring_L, ring_seq,
             )
 
         doc_new, L_new = jax.lax.fori_loop(0, R, run_body, (doc2, L2))
-        err = err | (L_new > Lc).astype(jnp.int32)
+        err = err | (L_new > Lc).astype(jnp.int32) * ERR_CAPACITY
 
-        # 5. Push the new trunk state into the ring (evict oldest).
+        # 5. Push the new trunk state into the ring (evict oldest); the
+        #    watermark remembers the newest move-bearing commit.
         ring_ids = jnp.roll(ring_ids, -1, axis=0).at[W - 1].set(doc_new)
         ring_L = jnp.roll(ring_L, -1).at[W - 1].set(L_new)
         ring_seq = jnp.roll(ring_seq, -1).at[W - 1].set(seq)
-        return (doc_new, L_new, ring_ids, ring_L, ring_seq, err), None
+        mov_seq = jnp.where(has_move, seq, mov_seq)
+        return (doc_new, L_new, ring_ids, ring_L, ring_seq, mov_seq,
+                err), None
 
-    init = (doc_ids, L, ring_ids, ring_L, ring_seq, jnp.int32(0))
+    init = (doc_ids, L, ring_ids, ring_L, ring_seq,
+            jnp.asarray(mov_seq0, jnp.int32), jnp.int32(0))
     xs = {
         "del": commits.del_mask,
         "ins": commits.ins_cnt,
         "ids": commits.ins_ids,
+        "mov": commits.mov_mask,
         "run_start": commits.run_start,
         "run_len": commits.run_len,
         "run_off": commits.run_off,
         "ref": commits.ref,
         "seq": commits.seq,
     }
-    (doc_ids, L, _ri, _rl, _rs, err), _ = jax.lax.scan(step, init, xs)
+    (doc_ids, L, _ri, _rl, _rs, _mv, err), _ = jax.lax.scan(step, init, xs)
     return doc_ids, L, err

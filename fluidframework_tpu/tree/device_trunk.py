@@ -9,6 +9,14 @@ with the dense rebase kernel (``ops/tree_kernel.py``), applies the result
 to the trunk document, and pushes it into the ring. ``vmap`` batches
 independent documents — the config-3 shape (N docs × C sequenced edits).
 
+Move-bearing commits ride this scan too (r7): the ring carries the full
+dense IR including the move lanes (``mov_id``/``mov_off`` detach side,
+``pool_mid``/``pool_off`` attach side), and ``rebase_change`` resolves
+capture/splice per step — so a stream mixing ``mout``/``min`` with plain
+edits is one compiled graph, no host fallback for the mark kind itself.
+``CommitBatch`` move lanes default to None for move-free callers (config
+3b keeps its exact shapes); ``trunk_scan`` materializes zeros.
+
 Restriction (matches the generated workload): a commit's refSeq covers all
 of its author's own earlier commits, so every ring entry newer than the ref
 is a concurrent *other-session* commit and the rebase chain is exactly the
@@ -24,7 +32,7 @@ co-iteration.
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -41,20 +49,31 @@ class CommitBatch(NamedTuple):
 
     ``seq``/``ref`` are DOCUMENT sequence numbers (sparse is fine — other
     channels' ops consume seqs too); only their order matters. ``seq``
-    must be strictly increasing and > 0."""
+    must be strictly increasing and > 0. The move lanes mirror
+    ``DenseChange`` (None = move-free stream; zeros are materialized)."""
 
     del_mask: jnp.ndarray  # int32[C, Lc]
     ins_cnt: jnp.ndarray  # int32[C, Lc+1]
     ins_ids: jnp.ndarray  # int32[C, Pc]
     ref: jnp.ndarray  # int32[C] refSeq of each commit
     seq: jnp.ndarray  # int32[C] sequence number of each commit
+    mov_id: Optional[jnp.ndarray] = None  # int32[C, Lc]
+    mov_off: Optional[jnp.ndarray] = None  # int32[C, Lc]
+    pool_mid: Optional[jnp.ndarray] = None  # int32[C, Pc]
+    pool_off: Optional[jnp.ndarray] = None  # int32[C, Pc]
+
+
+def _with_move_lanes(commits: CommitBatch) -> CommitBatch:
+    if commits.mov_id is not None:
+        return commits
+    zl = jnp.zeros_like(commits.del_mask)
+    zp = jnp.zeros_like(commits.ins_ids)
+    return commits._replace(mov_id=zl, mov_off=zl, pool_mid=zp, pool_off=zp)
 
 
 def _select(pred, a: DenseChange, b: DenseChange) -> DenseChange:
     return DenseChange(
-        jnp.where(pred, a.del_mask, b.del_mask),
-        jnp.where(pred, a.ins_cnt, b.ins_cnt),
-        jnp.where(pred, a.ins_ids, b.ins_ids),
+        *[jnp.where(pred, x, y) for x, y in zip(a, b)]
     )
 
 
@@ -65,18 +84,25 @@ def trunk_scan(doc_ids, L, commits: CommitBatch, W: int):
     the W-entry ring (concurrent trunk commits were already evicted, so the
     rebase chain would be incomplete) — callers must fall back to the host
     path for that stream rather than trust the result."""
+    commits = _with_move_lanes(commits)
     Lc = doc_ids.shape[-1]
     Pc = commits.ins_ids.shape[-1]
     ring_del = jnp.zeros((W, Lc), jnp.int32)
     ring_ins = jnp.zeros((W, Lc + 1), jnp.int32)
     ring_ids = jnp.zeros((W, Pc), jnp.int32)
+    ring_mid = jnp.zeros((W, Lc), jnp.int32)
+    ring_moff = jnp.zeros((W, Lc), jnp.int32)
+    ring_pmid = jnp.zeros((W, Pc), jnp.int32)
+    ring_poff = jnp.zeros((W, Pc), jnp.int32)
     ring_L = jnp.zeros(W, jnp.int32)
     ring_seq = jnp.zeros(W, jnp.int32)  # 0 = empty slot
 
     def step(carry, inp):
-        (doc_ids, L, ring_del, ring_ins, ring_ids, ring_L, ring_seq,
-         max_evicted, err) = carry
-        c = DenseChange(inp["del"], inp["ins"], inp["ids"])
+        (doc_ids, L, ring, ring_L, ring_seq, max_evicted, err) = carry
+        c = DenseChange(
+            inp["del"], inp["ins"], inp["ids"], inp["mid"], inp["moff"],
+            inp["pmid"], inp["poff"],
+        )
         ref = inp["ref"]
         k = inp["seq"]
         # Ring-window guard: the commit rebases over trunk seqs in
@@ -92,7 +118,7 @@ def trunk_scan(doc_ids, L, commits: CommitBatch, W: int):
         # an unrolled Python loop: one rebase body in the compiled graph
         # instead of W copies (compile time at W=16 is otherwise minutes).
         def fold(w, cc):
-            over = DenseChange(ring_del[w], ring_ins[w], ring_ids[w])
+            over = DenseChange(*[r[w] for r in ring])
             active = (ring_seq[w] > ref) & (ring_seq[w] > 0)
             return _select(active, rebase_change(cc, over, ring_L[w]), cc)
 
@@ -100,24 +126,30 @@ def trunk_scan(doc_ids, L, commits: CommitBatch, W: int):
         new_doc, new_L = apply_change(doc_ids, L, c)
         # Push (c, L, seq=k) into the ring; record the evicted seq.
         max_evicted = jnp.maximum(max_evicted, ring_seq[0])
-        ring_del = jnp.roll(ring_del, -1, axis=0).at[W - 1].set(c.del_mask)
-        ring_ins = jnp.roll(ring_ins, -1, axis=0).at[W - 1].set(c.ins_cnt)
-        ring_ids = jnp.roll(ring_ids, -1, axis=0).at[W - 1].set(c.ins_ids)
+        ring = tuple(
+            jnp.roll(r, -1, axis=0).at[W - 1].set(lane)
+            for r, lane in zip(ring, c)
+        )
         ring_L = jnp.roll(ring_L, -1).at[W - 1].set(L)
         ring_seq = jnp.roll(ring_seq, -1).at[W - 1].set(k)
         return (
-            new_doc, new_L, ring_del, ring_ins, ring_ids, ring_L,
-            ring_seq, max_evicted, err,
+            new_doc, new_L, ring, ring_L, ring_seq, max_evicted, err,
         ), None
 
     init = (
-        doc_ids, L, ring_del, ring_ins, ring_ids, ring_L, ring_seq,
-        jnp.int32(0), jnp.int32(0),
+        doc_ids, L,
+        (ring_del, ring_ins, ring_ids, ring_mid, ring_moff, ring_pmid,
+         ring_poff),
+        ring_L, ring_seq, jnp.int32(0), jnp.int32(0),
     )
     xs = {
         "del": commits.del_mask,
         "ins": commits.ins_cnt,
         "ids": commits.ins_ids,
+        "mid": commits.mov_id,
+        "moff": commits.mov_off,
+        "pmid": commits.pool_mid,
+        "poff": commits.pool_off,
         "ref": commits.ref,
         "seq": commits.seq,
     }

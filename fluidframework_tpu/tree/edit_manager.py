@@ -68,6 +68,20 @@ class _Branch:
     state: List[Cell] = field(default_factory=list)  # the session's view
 
 
+def _attach_counts(change: M.Changeset) -> Tuple[int, int]:
+    """(attach-pool cells, attach runs) of a commit: inserts AND move-ins
+    — move-in cells re-attach by identity, so they add no NET length, but
+    the pool and conservative length sizing must count them. Shared by
+    the eligibility gate and the shape pass so the two can never drift
+    (a gate admitting what the shapes can't hold would demote the whole
+    stream to host replay via the kernel's capacity err)."""
+    n_ins = sum(len(v) for t, v in change if t == "ins") + sum(
+        v[2] for t, v in change if t == "min"
+    )
+    n_runs = sum(1 for t, _v in change if t in ("ins", "min"))
+    return n_ins, n_runs
+
+
 def apply_ops_to_view(
     view: List[Cell],
     deleted_ids: Set[int],
@@ -147,10 +161,29 @@ class EditManager:
         # the ring seeds states behind the current trunk head.
         self._session_heads: Dict[int, int] = {}
         # Fast-path telemetry: commits integrated by the device kernel vs
-        # the host path (the counter VERDICT r2 #2 asks for).
+        # the host path (the counter VERDICT r2 #2 asks for), with the
+        # host tally BROKEN DOWN by fallback cause so the remaining tail
+        # is attributable (r7): every host-path commit increments exactly
+        # one reason bucket alongside ``host_commits``.
         self.device_commits = 0
         self.device_batches = 0
         self.host_commits = 0
+        self.host_fallback_reason: Dict[str, int] = {
+            "moves": 0,  # move-specific fallback (evicted move source,
+            #              move run past the kernel's capacity)
+            "pending_chain": 0,  # author had unacked own commits
+            "ring_evicted": 0,  # ref behind the retained state ring
+            "other_mark": 0,  # mark kind outside the wire IR
+            "own_session": 0,  # own echoes (inflight bookkeeping)
+            "capacity": 0,  # dense capacity / run-count limits
+            "min_batch": 0,  # below DEVICE_MIN_BATCH (dispatch not worth it)
+            "kernel": 0,  # device err lane without a finer cause
+        }
+        # Cross-batch move-id watermark: highest seq of any ingested
+        # move-bearing commit. Seeds the kernel ring's watermark so a
+        # ring miss that crosses a move source reports the distinct
+        # ERR_MOVE_EVICTED bit (explicit fallback, never silent).
+        self._move_head = -1
 
     # -- authoring / view -----------------------------------------------------
 
@@ -187,6 +220,8 @@ class EditManager:
         b.chain_seqs.append(commit.seq)
         b.state = M.apply(b.state, commit.change)
         self._session_heads[commit.session] = commit.seq
+        if M.has_moves(commit.change):
+            self._move_head = max(self._move_head, commit.seq)
 
         self.trunk.append(tc)
         self.trunk_state = M.apply(self.trunk_state, tc.trunk_change)
@@ -233,8 +268,12 @@ class EditManager:
           exactly trunk-at-ref, the kernel's ring entry) and refs a seq
           the W-deep state ring retains (the ring seeds the retained
           doc-commit tail, so steady streaming stays eligible);
-        - marks within the {skip, del, ins} vocabulary, run count within
-          DEVICE_MAX_RUNS, dense capacities within DEVICE_MAX_LC.
+        - marks within the FULL wire vocabulary {skip, del, ins, mout,
+          min} (r7: move-bearing commits are device-native — the encoder
+          lowers ``mout``/``min`` into the kernel's move lane + attach
+          runs of the SAME interned cells, the id-anchor transport on
+          device), run count within DEVICE_MAX_RUNS, dense capacities
+          within DEVICE_MAX_LC.
 
         Round 3's additional B-boundary ("nothing may ever rebase into a
         device range") is GONE: the anchor + replay-log machinery
@@ -246,31 +285,64 @@ class EditManager:
         if not commits:
             self.advance_min_seq(min_seq)
             return
-        prefix = self._device_prefix(commits)
+        prefix, reason = self._device_prefix_ex(commits)
         if prefix:
-            ok = self._device_ingest(
+            ok, err_reason = self._device_ingest(
                 commits[:prefix], self._em_lowest_ref(commits)
             )
             if ok:
                 commits = commits[prefix:]
+            else:
+                reason = err_reason
         for c in commits:
             self.add_sequenced(c)
-            self.host_commits += 1
+            self._count_host(reason)
         self.advance_min_seq(min_seq)
 
+    def _count_host(self, reason: str) -> None:
+        """One host-path commit, attributed to its fallback cause."""
+        self.host_commits += 1
+        key = reason or "kernel"
+        self.host_fallback_reason[key] = (
+            self.host_fallback_reason.get(key, 0) + 1
+        )
+
+    @staticmethod
+    def _err_reason(err: int) -> str:
+        """Map the kernel's err bitmask to a fallback-reason bucket."""
+        from fluidframework_tpu.tree import device_em as DE
+
+        if err & DE.ERR_MOVE_EVICTED:
+            return "moves"  # ring-evicted move source, reported explicitly
+        if err & DE.ERR_RING_MISS:
+            return "ring_evicted"
+        if err & DE.ERR_CAPACITY:
+            return "capacity"
+        return "kernel"
+
     def _device_prefix(self, commits: List[Commit]) -> int:
-        """Length of the maximal device-eligible prefix. Round 3's
+        """Length of the maximal device-eligible prefix (see
+        ``_device_prefix_ex``)."""
+        return self._device_prefix_ex(commits)[0]
+
+    def _device_prefix_ex(
+        self, commits: List[Commit]
+    ) -> Tuple[int, str]:
+        """(Length of the maximal device-eligible prefix, fallback reason
+        for the remainder — "" when the whole run is eligible). Round 3's
         B-boundary fixpoint (nothing may EVER rebase into a device range)
         is gone: the anchor + replay-log machinery reconstructs any
         admissible state inside device ranges host-side, so eligibility
         is purely per-commit — caught-up author (cross-batch session
-        heads), ref within the ring's retained window, dense-IR marks,
+        heads), ref within the ring's retained window, wire-IR marks
+        (r7: mout/min included — the has_moves host gate is retired),
         and capacity."""
         if self.inflight != 0:
-            return 0
+            return 0, "pending_chain"
         lr = self._em_lowest_ref(commits)
         total_ins = len(self.trunk_state)
         prefix = 0
+        reason = ""
         # Author caught-up checks start from the CROSS-batch session heads
         # (a chain pending since an earlier boxcar is invisible in-batch).
         last_of: Dict[int, int] = dict(self._session_heads)
@@ -281,35 +353,45 @@ class EditManager:
         retained = self._em_ring_seed_seqs(lr)
         for c in commits:
             if c.session == self.session:
+                reason = "own_session"
                 break
             if c.ref < last_of.get(c.session, 0):
                 # Author had a pending chain when authoring: its view is
                 # NOT trunk-at-ref; host path reconstructs the mirror.
+                reason = "pending_chain"
                 break
             if c.ref < retained[0]:
-                break  # ring would have evicted the ref state
-            if any(t not in M.DEVICE_MARK_KINDS for t, _v in c.change):
-                # Mark kinds beyond the dense IR — move-bearing changesets
-                # (mout/min, the reference's MoveOut/MoveIn,
-                # format.ts:14-220) — fall back to the host algebra BY
-                # CONTRACT, never silently miscompiled; the host rebase/
-                # compose handle them (tree/marks.py capture/splice) and
-                # the device share under a move-bearing workload is a
-                # measured number (bench config 3c move mix).
+                # Ring would have evicted the ref state. When the evicted
+                # span holds a move source the fallback is attributed to
+                # moves — the host-side mirror of the kernel's
+                # ERR_MOVE_EVICTED watermark bit.
+                reason = (
+                    "moves" if self._move_head > c.ref else "ring_evicted"
+                )
                 break
-            n_ins = sum(len(v) for t, v in c.change if t == "ins")
-            n_runs = sum(1 for t, _v in c.change if t == "ins")
+            if any(t not in M.DEVICE_MARK_KINDS for t, _v in c.change):
+                # Mark kinds beyond the wire IR are refused loudly — with
+                # mout/min device-native (r7) this only fires for foreign
+                # kinds, which the host algebra rejects too.
+                reason = "other_mark"
+                break
+            has_mv = M.has_moves(c.change)
+            n_ins, n_runs = _attach_counts(c.change)
             total_ins += n_ins
             if total_ins + 8 > self.DEVICE_MAX_LC:
+                reason = "moves" if has_mv else "capacity"
                 break
             if n_runs > self.DEVICE_MAX_RUNS:
+                reason = "moves" if has_mv else "capacity"
                 break
             last_of[c.session] = c.seq
             retained.append(c.seq)
             if len(retained) > self.DEVICE_WINDOW:
                 retained.pop(0)
             prefix += 1
-        return prefix if prefix >= self.DEVICE_MIN_BATCH else 0
+        if prefix >= self.DEVICE_MIN_BATCH:
+            return prefix, reason
+        return 0, (reason or "min_batch")
 
     def _em_lowest_ref(self, commits: List[Commit]) -> int:
         """The run's lowest ref, clamped to what is reconstructible —
@@ -464,7 +546,7 @@ class EditManager:
         max_ins = 8
         ins_total = 0
         for c in commits:
-            n_ins = sum(len(v) for t, v in c.change if t == "ins")
+            n_ins, _n_runs = _attach_counts(c.change)
             max_ins = max(max_ins, n_ins)
             ins_total += n_ins
         return (
@@ -503,6 +585,7 @@ class EditManager:
             ring_seq[k0 + j] = sq
         R = self.DEVICE_MAX_RUNS
         dm = np.zeros((C, lc), np.int32)
+        mv = np.zeros((C, lc), np.int32)
         ic = np.zeros((C, lc + 1), np.int32)
         ii = np.zeros((C, pc), np.int32)
         r_start = np.full((C, R), -1, np.int32)
@@ -511,6 +594,15 @@ class EditManager:
         refs = np.zeros(C, np.int32)
         seqs = np.zeros(C, np.int32)
         for k, c in enumerate(commits):
+            # Move streams are wire-complete per commit: every min's cells
+            # come from the commit's own mout marks (which carry values),
+            # so the lowering needs one pre-pass, not the author view.
+            vals_of: Dict[Tuple[int, int], Cell] = {}
+            for t, v in c.change:
+                if t == "mout":
+                    mid, start, vals = v
+                    for j, cell in enumerate(vals):
+                        vals_of[(mid, start + j)] = tuple(cell)
             i_in = 0  # position in the author view (input coords)
             i_out = 0  # position in the post view (run starts live here)
             p = 0
@@ -522,16 +614,25 @@ class EditManager:
                 elif t == "del":
                     dm[k, i_in : i_in + len(v)] = 1
                     i_in += len(v)
-                else:
-                    ic[k, i_in] += len(v)
+                elif t == "mout":
+                    # Detaches like a delete but rides the dedicated move
+                    # lane (feeds the kernel's move-id watermark).
+                    mv[k, i_in : i_in + len(v[2])] = 1
+                    i_in += len(v[2])
+                else:  # ins / min — both are attach runs
+                    cells = (
+                        v if t == "ins"
+                        else [vals_of[(v[0], v[1] + j)] for j in range(v[2])]
+                    )
+                    ic[k, i_in] += len(cells)
                     r_start[k, r] = i_out
-                    r_len[k, r] = len(v)
+                    r_len[k, r] = len(cells)
                     r_off[k, r] = p
                     r += 1
-                    for cell in v:
-                        ii[k, p] = intern(cell)
+                    for cell in cells:
+                        ii[k, p] = intern(tuple(cell))
                         p += 1
-                    i_out += len(v)
+                    i_out += len(cells)
             refs[k] = c.ref
             seqs[k] = c.seq
         # Identity padding: empty commits advancing seq keep shapes pow2
@@ -540,22 +641,25 @@ class EditManager:
             refs[k] = seqs[k - 1]
             seqs[k] = seqs[k - 1] + 1
         arrays = {
-            "dm": dm, "ic": ic, "ii": ii, "rs": r_start, "rl": r_len,
-            "ro": r_off, "refs": refs, "seqs": seqs,
+            "dm": dm, "mv": mv, "ic": ic, "ii": ii, "rs": r_start,
+            "rl": r_len, "ro": r_off, "refs": refs, "seqs": seqs,
         }
         return cell_of, (ring_ids, ring_L, ring_seq), arrays
 
     def _apply_em_result(self, commits: List[Commit], cell_of: List[Cell],
-                         out_ids, out_L, err) -> bool:
-        """Commit one document's scan result. False (state untouched)
-        when the kernel's err lane tripped — the caller replays the same
-        commits on the host path."""
+                         out_ids, out_L, err) -> Tuple[bool, str]:
+        """Commit one document's scan result. (False, reason) with state
+        untouched when the kernel's err lane tripped — the caller replays
+        the same commits on the host path, attributed to the err bit's
+        fallback bucket."""
         import numpy as np
 
         from fluidframework_tpu.ops import tree_kernel as TK
 
-        if int(np.asarray(err)):
-            return False  # ring miss / capacity: host path replays
+        err = int(np.asarray(err))
+        if err:
+            # ring miss / capacity / evicted move source: host replays
+            return False, self._err_reason(err)
         # Anchor the PRE-batch collab-floor state + log the batch's
         # commits: that is what _state_at replays when a later host-path
         # commit rebases into this (trunk-form-free) range. The anchor
@@ -573,20 +677,23 @@ class EditManager:
         self.view_state = list(self.trunk_state)  # inflight == 0
         for c in commits:
             self._session_heads[c.session] = c.seq
+            if M.has_moves(c.change):
+                self._move_head = max(self._move_head, c.seq)
         # No per-commit trunk forms were recorded: drop mirrors (they are
         # all behind the prefix boundary and would be pruned by the
         # advance anyway); future commits rebuild from _state_at(ref >= B).
         self.branches.clear()
         self.device_commits += len(commits)
         self.device_batches += 1
-        return True
+        return True, ""
 
-    def _device_ingest(self, commits: List[Commit], lr: int) -> bool:
+    def _device_ingest(self, commits: List[Commit], lr: int) -> Tuple[bool, str]:
         """Run the prefix through the lineage-aware EM scan
         (``tree/device_em.py`` — this class's own algebra as dense
-        kernels) as a group of one. Returns False — with state untouched —
-        when the kernel's err lane trips (ring miss / capacity), and the
-        caller replays the same commits on the host path."""
+        kernels) as a group of one. Returns (False, reason) — with state
+        untouched — when the kernel's err lane trips (ring miss /
+        capacity / evicted move source), and the caller replays the same
+        commits on the host path."""
         import numpy as np
 
         from fluidframework_tpu.tree.device_em import (
@@ -604,10 +711,11 @@ class EditManager:
         U = _pow2(len(cell_of) + 2)
         out_ids, out_L, err = batched_em_trunk_scan_ring(
             ring_ids[None], ring_L[None], ring_seq[None],
+            np.asarray([self._move_head], np.int32),
             EmCommitBatch(
                 a["dm"][None], a["ic"][None], a["ii"][None], a["rs"][None],
                 a["rl"][None], a["ro"][None], a["refs"][None],
-                a["seqs"][None],
+                a["seqs"][None], a["mv"][None],
             ),
             U,
         )
@@ -894,10 +1002,12 @@ def batch_ingest(
     )
 
     stats = {"device_docs": 0, "device_commits": 0, "host_commits": 0}
-    plans = []  # (em, commits, min_seq, prefix, device_ok)
+    plans = []  # (em, commits, min_seq, prefix, device_ok, reason)
     for em, commits, min_seq in items:
-        prefix = em._device_prefix(commits) if commits else 0
-        plans.append([em, commits, min_seq, prefix, False])
+        prefix, reason = (
+            em._device_prefix_ex(commits) if commits else (0, "")
+        )
+        plans.append([em, commits, min_seq, prefix, False, reason])
     elig = [p for p in plans if p[3]]
     if elig:
         needs = [
@@ -917,15 +1027,16 @@ def batch_ingest(
         ring_ids = np.stack([e[1][0] for e in enc])
         ring_L = np.stack([e[1][1] for e in enc])
         ring_seq = np.stack([e[1][2] for e in enc])
+        mov_seq0 = np.asarray([p[0]._move_head for p in elig], np.int32)
         stacked = {
             k: np.stack([e[2][k] for e in enc]) for k in enc[0][2]
         }
         out_ids, out_L, err = batched_em_trunk_scan_ring(
-            ring_ids, ring_L, ring_seq,
+            ring_ids, ring_L, ring_seq, mov_seq0,
             EmCommitBatch(
                 stacked["dm"], stacked["ic"], stacked["ii"], stacked["rs"],
                 stacked["rl"], stacked["ro"], stacked["refs"],
-                stacked["seqs"],
+                stacked["seqs"], stacked["mv"],
             ),
             U,
         )
@@ -933,18 +1044,20 @@ def batch_ingest(
         out_L = np.asarray(out_L)
         err = np.asarray(err)
         for i, p in enumerate(elig):
-            ok = p[0]._apply_em_result(
+            ok, err_reason = p[0]._apply_em_result(
                 p[1][: p[3]], enc[i][0], out_ids[i], out_L[i], err[i]
             )
             p[4] = ok
             if ok:
                 stats["device_docs"] += 1
                 stats["device_commits"] += p[3]
-    for em, commits, min_seq, prefix, device_ok in plans:
+            else:
+                p[5] = err_reason
+    for em, commits, min_seq, prefix, device_ok, reason in plans:
         rest = commits[prefix:] if device_ok else commits
         for c in rest:
             em.add_sequenced(c)
-            em.host_commits += 1
+            em._count_host(reason)
             stats["host_commits"] += 1
         em.advance_min_seq(min_seq)
     return stats

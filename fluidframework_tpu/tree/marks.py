@@ -62,10 +62,14 @@ Changeset = List[Mark]
 # The complete mark vocabulary of this IR.
 MARK_KINDS = ("skip", "del", "ins", "mout", "min")
 
-# The subset the dense device lowering accepts (ops/tree_kernel.from_marks
-# and the EditManager device-prefix gate): move-bearing changesets fall
-# back to this host algebra BY CONTRACT — never silently miscompiled.
-DEVICE_MARK_KINDS = ("skip", "del", "ins")
+# The vocabulary the dense device lowering accepts (ops/tree_kernel
+# .from_marks and the EditManager device-prefix gate). Since r7 this is
+# the FULL mark vocabulary: mout/min lower into the dense move lanes
+# (per-slot move-id/offset + tagged attach-pool atoms, resolved on device
+# by a two-phase capture/splice kernel), so move-bearing commits ride the
+# EM kernel instead of forcing the per-commit host fold. Foreign kinds
+# are still refused loudly by both engines.
+DEVICE_MARK_KINDS = MARK_KINDS
 
 
 def _check_kind(t: str) -> None:
@@ -277,6 +281,41 @@ def lower_moves(c: Changeset) -> Changeset:
             )
         else:
             out.append((t, v))
+    return normalize(out)
+
+
+def lift_dense(
+    del_mask, ins_cnt, ins_ids, mov_id, mov_off, pool_mid, pool_off, L,
+    doc,
+) -> Changeset:
+    """Lift the dense device IR (``ops/tree_kernel.DenseChange`` lanes)
+    back to a mark changeset — the inverse of ``tree_kernel.from_marks``.
+    Dense deletes/move-outs are positional, so the pre-image document
+    ``doc`` supplies the carried values; dense move tags are 1-based
+    (0 = none) and lift back to the host's 0-based mids. Used by the
+    wire-golden fixtures and device-path debugging, not the hot path."""
+    out: Changeset = []
+    p = 0
+    for i in range(int(L) + 1):
+        n_attach = int(ins_cnt[i])
+        for _ in range(n_attach):
+            if int(pool_mid[p]) > 0:
+                out.append(
+                    ("min", (int(pool_mid[p]) - 1, int(pool_off[p]), 1))
+                )
+            else:
+                out.append(("ins", [int(ins_ids[p])]))
+            p += 1
+        if i == int(L):
+            break
+        if int(del_mask[i]):
+            out.append(("del", [doc[i]]))
+        elif int(mov_id[i]) > 0:
+            out.append(
+                ("mout", (int(mov_id[i]) - 1, int(mov_off[i]), [doc[i]]))
+            )
+        else:
+            out.append(("skip", 1))
     return normalize(out)
 
 

@@ -74,11 +74,14 @@ class SharedTree(SharedObject):
 
     @property
     def ingest_stats(self) -> dict:
-        """Counters proving which path integrated commits."""
+        """Counters proving which path integrated commits, with the host
+        tail broken down by fallback cause (r7: with moves device-native,
+        the remaining host share must be attributable, not a lump)."""
         return {
             "device_commits": self._em.device_commits,
             "device_batches": self._em.device_batches,
             "host_commits": self._em.host_commits,
+            "host_fallback_reason": dict(self._em.host_fallback_reason),
         }
 
     # -- reads ----------------------------------------------------------------
@@ -166,7 +169,7 @@ class SharedTree(SharedObject):
             # Own echoes adjust inflight bookkeeping — integrate in order.
             self._drain()
             self._em.add_sequenced(commit)
-            self._em.host_commits += 1
+            self._em._count_host("own_session")
             self._em.advance_min_seq(msg.minimum_sequence_number)
             self._ingest_min_seq = msg.minimum_sequence_number
         else:

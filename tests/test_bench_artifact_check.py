@@ -48,7 +48,33 @@ def test_metrics_may_span_multiple_record_lines(tmp_path):
         '"pipeline_serving_ops_per_sec": 2}',
         '{"deli_scribe_e2e_ops_per_sec": 3}',
         '{"fleet_mesh_ops_per_sec": 4}',
+        '{"tree_moves_device_fraction": 0.97}',
     ])
+    assert cba.check(str(tmp_path)) == 0
+
+
+def test_r7_requires_tree_moves_fraction(tmp_path):
+    """An r7+ artifact with the serving trio but no config-3c-moves
+    device fraction is incomplete — the device-native move marks number
+    must be driver-captured."""
+    cba = _tool()
+    _write(tmp_path, "BENCH_r07.json", [json.dumps({
+        "pipeline_serving_ops_per_sec": 2,
+        "deli_scribe_e2e_ops_per_sec": 3,
+        "fleet_mesh_ops_per_sec": 4,
+    })])
+    assert cba.check(str(tmp_path)) == 1
+
+
+def test_r6_exempt_from_tree_moves_fraction(tmp_path):
+    """The r6 artifact predates the metric: the serving trio alone
+    passes (per-key since-round gating, not one global baseline)."""
+    cba = _tool()
+    _write(tmp_path, "BENCH_r06.json", [json.dumps({
+        "pipeline_serving_ops_per_sec": 2,
+        "deli_scribe_e2e_ops_per_sec": 3,
+        "fleet_mesh_ops_per_sec": 4,
+    })])
     assert cba.check(str(tmp_path)) == 0
 
 

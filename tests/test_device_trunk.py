@@ -31,6 +31,31 @@ def test_device_trunk_matches_host(seed):
         assert got == want, f"doc {d}: {got} != {want}"
 
 
+@pytest.mark.parametrize("seed", range(6))
+def test_device_trunk_with_moves_matches_host(seed):
+    """Move-bearing concurrent streams through the positional trunk scan
+    (r7): the ring carries the full move lanes and per-step rebase
+    resolves capture/splice — parity against the host marks fold."""
+    rng = np.random.default_rng(seed + 17000)
+    Lc, Pc, W = 64, 32, 8
+    n_docs, C = 3, 20
+    streams = gen_streams(
+        rng, n_docs, C, n_sessions=3, W=W, Lc=Lc, move_prob=0.3
+    )
+    assert any(
+        M.has_moves(c) for commits in streams for _ref, c in commits
+    )
+    batch = to_device_batch(streams, Lc, Pc)
+    doc_ids = np.zeros((n_docs, Lc), np.int32)
+    L0 = np.zeros(n_docs, np.int32)
+    out_ids, out_L, err = batched_trunk_scan(doc_ids, L0, batch, W)
+    assert not np.asarray(err).any()
+    for d in range(n_docs):
+        want = host_trunk(streams[d])
+        got = TK.dense_to_doc(out_ids[d], out_L[d])
+        assert got == want, f"doc {d}: {got} != {want}"
+
+
 def test_device_trunk_single_session_is_sequential_apply():
     """One session, no concurrency: the trunk is just sequential apply."""
     Lc, Pc, W = 32, 16, 4

@@ -59,6 +59,40 @@ def test_load_over_network_sockets():
         srv.stop()
 
 
+def test_load_with_move_bearing_tree_client():
+    """CI-sized smoke of the tree-in-load path: SharedTree traffic with
+    first-class moves mixed into the op soup converges across replicas
+    (keeps the tree lane of the harness covered in tier-1; the full
+    16-client envelope is the slow profile below)."""
+    profile = LoadProfile(
+        n_clients=4, total_ops=220, seed=5, fault_rate=0.01,
+        offline_ops=15, tree_weight=0.3, doc_id="tree-load",
+    )
+    report = LoadRunner(LocalFluidService(), profile).run()
+    assert report.converged, f"divergence: {report}"
+    assert report.tree_ops_submitted > 0
+    assert report.tree_moves_submitted > 0, "profile expected tree moves"
+
+
+@pytest.mark.slow
+def test_load_16_clients_2k_ops_with_moves():
+    """Stress envelope (r7 satellite): 16 clients / 2k ops — far beyond
+    the 3–6-client CI profiles — with a SharedTree channel carrying
+    concurrent first-class moves plus offline-window faults. Asserts
+    convergence of every channel family and that moves actually flowed
+    (STATUS.md's old envelope never exercised concurrent moves)."""
+    profile = LoadProfile(
+        n_clients=16, total_ops=2000, seed=11, fault_rate=0.004,
+        offline_ops=40, tree_weight=0.25, doc_id="stress-moves",
+    )
+    report = LoadRunner(LocalFluidService(), profile).run()
+    assert report.converged, f"divergence: {report}"
+    assert report.ops_submitted == 2000
+    assert report.tree_moves_submitted >= 20
+    assert report.faults_injected > 0
+    assert report.reconnects == report.faults_injected
+
+
 def test_slot_recycling_under_reconnect_churn():
     """Reconnect churn far beyond MAX_WRITERS must not exhaust a document:
     slots recycle once their leave falls below the collab-window floor."""

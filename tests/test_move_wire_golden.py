@@ -1,0 +1,176 @@
+"""Back-compat golden for the move wire (r7 satellite).
+
+The ``mout``/``min`` changeset encoding is now load-bearing in THREE
+layers — the wire (SharedTree commits), the id-anchor transport lowering
+(``marks.lower_moves``, what the EditManager algebra consumes), and the
+dense device IR (``tree_kernel.from_marks`` move lanes). This golden pins
+all three for a canonical move-bearing session, so a future IR change
+cannot silently break N-1 readers: any intentional format change must
+regenerate the fixture and say so in review.
+
+Regenerate (after an INTENTIONAL format change):
+    python tests/test_move_wire_golden.py regenerate
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service.local_server import LocalFluidService
+from fluidframework_tpu.tree import marks as M
+from fluidframework_tpu.tree.shared_tree import SharedTree
+
+GOLDEN = os.path.join(
+    os.path.dirname(__file__), "goldens", "golden_move_wire.json"
+)
+
+
+def canonical_move_session():
+    """Deterministic two-client session: seed inserts, a right-move, a
+    left-move, and a CONCURRENT move/delete pair (capture semantics on
+    the wire). Returns (wire_ops, final_values)."""
+    svc = LocalFluidService()
+    a = ContainerRuntime(svc, "golden-moves", channels=(SharedTree("t"),))
+    b = ContainerRuntime(svc, "golden-moves", channels=(SharedTree("t"),))
+
+    def drain():
+        for rt in (a, b):
+            rt.flush()
+        busy = True
+        while busy:
+            busy = any(rt.process_incoming() for rt in (a, b))
+
+    ta, tb = a.get_channel("t"), b.get_channel("t")
+    ta.insert_nodes(0, ["a", "b", "c", "d", "e", "f"])
+    drain()
+    ta.move_nodes(1, 2, 3)  # right-move: mout before min on the wire
+    drain()
+    tb.move_nodes(4, 1, 0)  # left-move: min before mout on the wire
+    drain()
+    # Concurrent: a moves a span while b deletes part of it (deletion
+    # beats movement through the id-anchor transport).
+    ta.move_nodes(0, 2, 2)
+    tb.delete_nodes(1, 1)
+    drain()
+    assert ta.get() == tb.get()
+    wire = [
+        {
+            "seq": op.sequence_number,
+            "client": op.client_id,
+            "ref": op.reference_sequence_number,
+            "marks": op.contents["contents"]["marks"],
+        }
+        for op in svc.get_deltas("golden-moves")
+        if op.type == 1 and op.contents.get("address") == "t"
+    ]
+    return wire, ta.get()
+
+
+def build_fixture() -> dict:
+    wire, final = canonical_move_session()
+    move_ops = [
+        rec for rec in wire
+        if any(t in ("mout", "min") for t, _v in rec["marks"])
+    ]
+    assert len(move_ops) == 3, "session must carry three move commits"
+    # The id-anchor transport lowering of each move commit: detach +
+    # re-attach of the SAME cell ids (what every EditManager replica
+    # actually folds — N-1 readers depend on this being stable).
+    lowered = [
+        M.lower_moves([(t, _decode(t, v)) for t, v in rec["marks"]])
+        for rec in move_ops
+    ]
+    # The dense device lanes of the canonical right-move (ids as values).
+    from fluidframework_tpu.ops import tree_kernel as TK
+
+    ids_only = [
+        (t, _ids_form(t, _decode(t, v))) for t, v in move_ops[0]["marks"]
+    ]
+    dc, _len = TK.from_marks(ids_only, 16, 8)
+    dense = {
+        "del_mask": np.asarray(dc.del_mask).tolist(),
+        "ins_cnt": np.asarray(dc.ins_cnt).tolist(),
+        "ins_ids": np.asarray(dc.ins_ids).tolist(),
+        "mov_id": np.asarray(dc.mov_id).tolist(),
+        "mov_off": np.asarray(dc.mov_off).tolist(),
+        "pool_mid": np.asarray(dc.pool_mid).tolist(),
+        "pool_off": np.asarray(dc.pool_off).tolist(),
+    }
+    return {
+        "wire": wire,
+        "final_values": final,
+        "id_anchor_lowering": [_jsonable(c) for c in lowered],
+        "dense_lanes_first_move": dense,
+    }
+
+
+def _decode(t, v):
+    """Wire JSON -> mark tuple payload (lists -> tuples for cells)."""
+    if t in ("del", "ins"):
+        return [tuple(c) for c in v]
+    if t == "mout":
+        return (v[0], v[1], [tuple(c) for c in v[2]])
+    if t == "min":
+        return (v[0], v[1], v[2])
+    return v
+
+
+def _ids_form(t, v):
+    """Cells -> bare int ids (the dense IR's value form)."""
+    if t in ("del", "ins"):
+        return [c[0] for c in v]
+    if t == "mout":
+        return (v[0], v[1], [c[0] for c in v[2]])
+    return v
+
+
+def _jsonable(c):
+    return json.loads(json.dumps(c))
+
+
+def test_move_wire_matches_golden():
+    assert os.path.exists(GOLDEN), (
+        "golden_move_wire.json missing — run "
+        "`python tests/test_move_wire_golden.py regenerate`"
+    )
+    with open(GOLDEN) as f:
+        want = json.load(f)
+    got = _jsonable(build_fixture())
+    assert got["wire"] == want["wire"], (
+        "move WIRE encoding drifted — an N-1 reader would misdecode "
+        "these commits; if intentional, regenerate the golden and flag "
+        "the compat break in review"
+    )
+    assert got["final_values"] == want["final_values"]
+    assert got["id_anchor_lowering"] == want["id_anchor_lowering"], (
+        "lower_moves (id-anchor transport) output drifted"
+    )
+    assert got["dense_lanes_first_move"] == want["dense_lanes_first_move"], (
+        "dense move-lane lowering drifted"
+    )
+
+
+def test_golden_wire_replays_through_a_fresh_reader():
+    """The committed wire ops replay byte-for-byte into the same final
+    document on a fresh reader build — the actual N-1 scenario."""
+    with open(GOLDEN) as f:
+        want = json.load(f)
+    from fluidframework_tpu.tree.edit_manager import Commit, EditManager
+
+    em = EditManager(session=-1)
+    for rec in want["wire"]:
+        em.add_sequenced(Commit(
+            session=rec["client"], seq=rec["seq"], ref=rec["ref"],
+            change=[(t, _decode(t, v)) for t, v in rec["marks"]],
+        ))
+    assert [v for _i, v in em.trunk_state] == want["final_values"]
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "regenerate":
+        with open(GOLDEN, "w") as f:
+            json.dump(_jsonable(build_fixture()), f, indent=1, sort_keys=True)
+        print(f"wrote {GOLDEN}")

@@ -15,6 +15,21 @@ from fluidframework_tpu.tree import marks as M
 from fluidframework_tpu.tree.edit_manager import Commit, EditManager
 
 
+def _rand_move(rng, view):
+    """A first-class move changeset over `view` (mout/min marks)."""
+    i0 = int(rng.integers(0, len(view) - 1))
+    cnt = int(rng.integers(1, min(3, len(view) - i0) + 1))
+    dest = int(rng.integers(0, len(view) - cnt + 1))
+    cells = view[i0 : i0 + cnt]
+    if dest <= i0:
+        change = [M.skip(dest), M.move_in(0, cnt),
+                  M.skip(i0 - dest), M.move_out(0, cells)]
+    else:
+        change = [M.skip(i0), M.move_out(0, cells),
+                  M.skip(dest - i0), M.move_in(0, cnt)]
+    return M.normalize(change)
+
+
 def _rand_change(rng, view, sid, nid):
     change = []
     i = 0
@@ -38,10 +53,11 @@ def _rand_change(rng, view, sid, nid):
     return M.normalize(change)
 
 
-def simulate(seed, n_commits=24, n_sessions=3, max_lag=6):
+def simulate(seed, n_commits=24, n_sessions=3, max_lag=6, move_prob=0.0):
     """Authentic wire streams: every session authors on its own
     EditManager view with no pending chain (waits for its own ack), refs =
-    its processed head. max_lag=0 degenerates to fully caught-up commits."""
+    its processed head. max_lag=0 degenerates to fully caught-up commits;
+    ``move_prob`` mixes in first-class move commits (mout/min)."""
     rng = np.random.default_rng(seed)
     sessions = [EditManager(session=100 + s) for s in range(n_sessions)]
     processed = [0] * n_sessions
@@ -60,7 +76,11 @@ def simulate(seed, n_commits=24, n_sessions=3, max_lag=6):
             em.add_sequenced(c)
         processed[s] = target
         assert em.inflight == 0
-        change = _rand_change(rng, em.local_view(), 100 + s, nid)
+        view = em.local_view()
+        if move_prob and len(view) >= 4 and rng.random() < move_prob:
+            change = _rand_move(rng, view)
+        else:
+            change = _rand_change(rng, view, 100 + s, nid)
         em.add_local(change)
         log.append(
             Commit(session=em.session, seq=k, ref=target, change=change)
@@ -288,6 +308,196 @@ def test_cross_document_batch_matches_sequential_calls():
     for a, b in zip(solo, grouped):
         assert a.trunk_state == b.trunk_state
         assert a.view_state == b.view_state
+
+
+def simulate_bounded(seed, n_commits, move_prob, max_lag=6):
+    """The config-3c stream shape: delete-biased size-bounded commits
+    with a move mix — the acceptance workload for the device fraction."""
+    rng = np.random.default_rng(seed)
+    sessions = [EditManager(session=100 + s) for s in range(3)]
+    processed = [0, 0, 0]
+    log = []
+    nid = [1]
+    for k in range(1, n_commits + 1):
+        s = int(rng.integers(0, 3))
+        em = sessions[s]
+        target = max(
+            processed[s],
+            max((c.seq for c in log if c.session == em.session), default=0),
+            len(log) - max_lag,
+        )
+        for c in log[processed[s] : target]:
+            em.add_sequenced(c)
+        processed[s] = target
+        view = em.local_view()
+        if move_prob and len(view) >= 4 and rng.random() < move_prob:
+            change = _rand_move(rng, view)
+        else:
+            change = []
+            i = 0
+            while i < len(view):
+                run = min(int(rng.integers(1, 3)), len(view) - i)
+                if rng.random() < 0.45 and len(view) > 24:
+                    change.append(M.delete(view[i : i + run]))
+                else:
+                    change.append(M.skip(run))
+                i += run
+            cells = [
+                ((100 + s) * 1000000 + nid[0] + j, nid[0] + j)
+                for j in range(2)
+            ]
+            nid[0] += 2
+            change.append(M.insert(cells))
+            change = M.normalize(change)
+        em.add_local(change)
+        log.append(
+            Commit(session=em.session, seq=k, ref=target, change=change)
+        )
+    return log
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_move_bearing_streams_ride_device_with_parity(seed):
+    """Move-bearing concurrent streams (r7): mout/min commits integrate
+    ON DEVICE through the EM kernel's move lanes with exact production
+    parity — the has_moves host gate is retired. At the acceptance
+    workload (the config-3c stream shape) the device fraction must clear
+    0.9 at the 5% move mix; the heavier 25% mix keeps parity honest
+    under move pressure."""
+    for move_prob in (0.05, 0.25):
+        log = simulate_bounded(
+            seed * 7 + 300, n_commits=32, move_prob=move_prob
+        )
+        want = _observer(log).trunk_state
+        em = EditManager(session=1)
+        wave = 16
+        for w0 in range(0, len(log), wave):
+            chunk = log[w0 : w0 + wave]
+            em.add_sequenced_batch(
+                list(chunk), max(0, chunk[-1].seq - 8)
+            )
+        assert em.trunk_state == want
+        assert em.view_state == want
+        frac = em.device_commits / len(log)
+        assert frac >= 0.9, (
+            f"move-bearing stream (p={move_prob}) must ride the device: "
+            f"fraction {frac} ({em.host_fallback_reason})"
+        )
+
+
+def test_move_heavy_catchup_is_fully_device_with_counters():
+    """A caught-up move-heavy backlog integrates entirely on device and
+    every fallback-reason counter stays zero — nothing is silently
+    attributed."""
+    log = simulate(909, n_commits=24, max_lag=0, move_prob=0.4)
+    assert any(M.has_moves(c.change) for c in log)
+    want = _observer(log).trunk_state
+    em = EditManager(session=1)
+    em.add_sequenced_batch(list(log), min_seq=log[-1].seq)
+    assert em.trunk_state == want
+    assert em.device_commits == len(log)
+    assert em.host_commits == 0
+    assert all(v == 0 for v in em.host_fallback_reason.values()), (
+        em.host_fallback_reason
+    )
+
+
+def test_host_fallback_reasons_are_attributed():
+    """Every host-path commit lands in exactly one reason bucket: the
+    counters sum to host_commits and name the cause (r7 satellite — the
+    fallback tail must be attributable, not a lump)."""
+    base = simulate(41, n_commits=12, max_lag=0)
+    head = base[-1].seq
+    emA = _observer(base)
+    nid = [70_000]
+    rng = np.random.default_rng(5)
+    c1 = _rand_change(rng, emA.local_view(), 9, nid)
+    view_after_c1 = M.apply(emA.local_view(), c1)
+    c2 = _rand_change(rng, view_after_c1, 9, nid)
+    # A pipelined author (pending chain) forces its second commit host-side.
+    log = base + [
+        Commit(session=900, seq=head + 1, ref=head, change=c1),
+        Commit(session=900, seq=head + 2, ref=head, change=c2),
+    ]
+    em = EditManager(session=1)
+    em.add_sequenced_batch(list(log), min_seq=0)
+    assert em.host_commits == sum(em.host_fallback_reason.values())
+    assert em.host_fallback_reason["pending_chain"] >= 1
+    # A tiny stream (below DEVICE_MIN_BATCH) attributes to min_batch.
+    em2 = EditManager(session=1)
+    tiny = simulate(42, n_commits=2)
+    em2.add_sequenced_batch(list(tiny), min_seq=0)
+    assert em2.host_fallback_reason["min_batch"] == len(tiny)
+    assert em2.host_commits == sum(em2.host_fallback_reason.values())
+
+
+def test_ring_evicted_move_source_falls_back_as_moves():
+    """A commit reffing BEHIND a move-bearing commit whose ring states
+    were pruned falls back explicitly attributed to moves (the move-id
+    watermark), not the generic eviction bucket."""
+    # Long enough that the W-deep ring's floor rises above old refs (the
+    # seed keeps only the newest W-2 doc-commit states).
+    log = simulate(77, n_commits=30, max_lag=0, move_prob=0.5)
+    assert any(M.has_moves(c.change) for c in log)
+    em = EditManager(session=1)
+    # Advance the collab floor to the head: older states are pruned.
+    em.add_sequenced_batch(list(log), min_seq=log[-1].seq)
+    assert em._move_head > 0
+    old_ref = 2
+    assert old_ref < em._move_head
+    late = [
+        Commit(session=950 + j, seq=log[-1].seq + j, ref=old_ref,
+               change=M.normalize([M.insert([(888800 + j, j)])]))
+        for j in range(1, 6)
+    ]
+    prefix, reason = em._device_prefix_ex(late)
+    assert prefix == 0
+    assert reason == "moves"
+    # The kernel-level watermark reports the same condition as a distinct
+    # err bit when the miss happens on device: a ring retaining only the
+    # seq-10 trunk, a watermark saying a move sequenced at 9, and a
+    # commit reffing 3 — the evicted span holds the move source.
+    from fluidframework_tpu.tree import device_em as DE
+
+    W, Lc, Pc, R, C = 4, 8, 4, 2, 4
+    ring_ids = np.zeros((W, Lc), np.int32)
+    ring_ids[W - 1, :4] = [1, 2, 3, 4]
+    ring_L = np.zeros(W, np.int32)
+    ring_L[W - 1] = 4
+    ring_seq = np.full(W, -1, np.int32)
+    ring_seq[W - 1] = 10
+    refs = np.asarray([3, 11, 12, 13], np.int32)
+    seqs = np.asarray([11, 12, 13, 14], np.int32)
+    batch = DE.EmCommitBatch(
+        np.zeros((C, Lc), np.int32),
+        np.zeros((C, Lc + 1), np.int32),
+        np.zeros((C, Pc), np.int32),
+        np.full((C, R), -1, np.int32),
+        np.zeros((C, R), np.int32),
+        np.zeros((C, R), np.int32),
+        refs, seqs,
+        np.zeros((C, Lc), np.int32),
+    )
+    _ids, _L, err = DE.batched_em_trunk_scan_ring(
+        ring_ids[None], ring_L[None], ring_seq[None],
+        np.asarray([9], np.int32),
+        DE.EmCommitBatch(*[x[None] for x in batch]),
+        16,
+    )
+    e = int(np.asarray(err)[0])
+    assert e & DE.ERR_RING_MISS
+    assert e & DE.ERR_MOVE_EVICTED
+    assert EditManager._err_reason(e) == "moves"
+    # Without a move behind the miss, the generic eviction bit alone.
+    _ids, _L, err2 = DE.batched_em_trunk_scan_ring(
+        ring_ids[None], ring_L[None], ring_seq[None],
+        np.asarray([-1], np.int32),
+        DE.EmCommitBatch(*[x[None] for x in batch]),
+        16,
+    )
+    e2 = int(np.asarray(err2)[0])
+    assert e2 & DE.ERR_RING_MISS and not (e2 & DE.ERR_MOVE_EVICTED)
+    assert EditManager._err_reason(e2) == "ring_evicted"
 
 
 def test_pipelined_author_survives_device_batch():

@@ -264,6 +264,25 @@ def test_move_laws_fuzz(seed):
     assert via_a == via_b
 
 
+@pytest.mark.parametrize("seed", range(20))
+def test_dense_lower_lift_roundtrip(seed):
+    """from_marks (dense lowering) followed by lift_dense reproduces the
+    normalized changeset exactly — mout/min included (the r7 dense move
+    lanes are a lossless encoding of the mark IR, up to run merging)."""
+    from fluidframework_tpu.ops import tree_kernel as TK
+
+    rng = np.random.default_rng(seed + 21000)
+    s = random_state(rng)
+    c = random_change_with_moves(rng, s)
+    dc, L = TK.from_marks(c, 64, 64)
+    lifted = M.lift_dense(
+        dc.del_mask, dc.ins_cnt, dc.ins_ids, dc.mov_id, dc.mov_off,
+        dc.pool_mid, dc.pool_off, len(s), s,
+    )
+    assert M.apply(s, lifted) == M.apply(s, c)
+    assert M.normalize(lifted) == M.normalize(c)
+
+
 @pytest.mark.parametrize("seed", range(30))
 def test_unit_engine_matches_run_engine_move_free(seed):
     """The unit-level canonical engine (the move path) must agree with
