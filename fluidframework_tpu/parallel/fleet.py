@@ -297,6 +297,7 @@ class _Pool:
         self.state = self._put(
             SegmentState(
                 *[
+                    # graftlint: readback(rare-path slot growth assembles on host; eager jnp concat would jit-compile per shape — see module docstring)
                     np.concatenate([np.array(a), b], axis=0)
                     for a, b in zip(self.state, pad)
                 ]
@@ -515,8 +516,8 @@ class DocFleet:
         errs = 0
         rows = 0
         for pool in self.pools.values():
-            err = np.asarray(pool.state.err)
-            cnt = np.asarray(pool.state.count)
+            err = np.asarray(pool.state.err)  # graftlint: readback(stats() is the explicit synchronous health API; serving rides begin_scan/finish_scan)
+            cnt = np.asarray(pool.state.count)  # graftlint: readback(same synchronous stats pull)
             live = pool.live_slots()
             errs += int(np.sum(err[live] != 0))
             rows += int(np.sum(cnt[live]))
@@ -563,8 +564,9 @@ class DocFleet:
         while dst.n_free() < len(hot):
             dst.grow_slots()
         # Writable host copies (np.asarray of a jax array is read-only).
+        # graftlint: readback(promotion migrates docs host-side: one copy + one upload per pool, rare by the high-water design)
         src_host = SegmentState(*[np.array(x) for x in pool.state])
-        dst_host = SegmentState(*[np.array(x) for x in dst.state])
+        dst_host = SegmentState(*[np.array(x) for x in dst.state])  # graftlint: readback(same promotion copy)
         empty = _np_batched_state(1, cap)
         free = [int(s) for s in np.flatnonzero(dst.doc_of_slot < 0)]
         for (slot, doc), dst_slot in zip(hot, free):
@@ -599,7 +601,7 @@ class DocFleet:
         """Live slots above the high-water mark — the single promotion
         predicate shared by tier promotion and sharded-overflow scans."""
         if counts is None:
-            counts = np.asarray(pool.state.count)
+            counts = np.asarray(pool.state.count)  # graftlint: readback(synchronous fallback when no begin_scan token was supplied)
         if len(counts) < pool.n_slots:
             # The pool grew slots after the scan was taken: unseen slots
             # read as empty (they were just placed; next scan covers them).
@@ -622,7 +624,7 @@ class DocFleet:
         for cap, pool in self.pools.items():
             if cap * 2 <= self.max_capacity:
                 continue
-            err = np.asarray(pool.state.err)
+            err = np.asarray(pool.state.err)  # graftlint: readback(overflow scan is a rare control-plane pass, not the serving loop)
             out.extend(
                 int(pool.doc_of_slot[s])
                 for s in self._hot_slots(pool, cap)
@@ -637,7 +639,7 @@ class DocFleet:
         cap, slot = self.placement[doc]
         pool = self.pools[cap]
         state = self.doc_state(doc)
-        host = SegmentState(*[np.array(x) for x in pool.state])
+        host = SegmentState(*[np.array(x) for x in pool.state])  # graftlint: readback(eviction hand-off to a ShardedDoc is a deliberate whole-pool migration)
         empty = _np_batched_state(1, cap)
         for lane in SEGMENT_LANES:
             getattr(host, lane)[slot] = np.asarray(getattr(empty, lane))[0]
@@ -667,6 +669,7 @@ class DocFleet:
             cap, slot = place
             counts = count_cache.get(cap)
             if counts is None:
+                # graftlint: readback(one [n_slots] count-lane pull per pool — the documented introspection cost)
                 counts = count_cache[cap] = np.asarray(
                     self.pools[cap].state.count
                 )
@@ -680,8 +683,8 @@ class DocFleet:
         cap, slot = self.placement[doc]
         pool = self.pools[cap]
         lanes, scal = _doc_gather(pool.state, slot)
-        lanes = np.asarray(lanes)
-        scal = np.asarray(scal)
+        lanes = np.asarray(lanes)  # graftlint: readback(read path: one device-side doc slice, not the pool)
+        scal = np.asarray(scal)  # graftlint: readback(rides the same doc-slice readback)
         return SegmentState(
             **{k: lanes[i] for i, k in enumerate(SEGMENT_LANES)},
             **{s: scal[i] for i, s in enumerate(_SCALARS)},

@@ -14,6 +14,7 @@ kernel, changes.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -79,6 +80,75 @@ def apply_and_stats(state: SegmentState, ops: jnp.ndarray):
     return out, stats
 
 
+# One jitted XLA step shared by every DocShard: re-wrapping per instance
+# (the old ``self._step = jax.jit(...)`` in __init__) made each new shard
+# re-trace an identical program (graftlint recompile-hazard).
+_jit_apply_and_stats = jax.jit(apply_and_stats, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _mesh_pallas_step(mesh: Mesh, axis: str, blk: int, interpret: bool):
+    """The Pallas apply + telemetry reduction under shard_map, cached per
+    (mesh, axis, block, interpret) so every DocShard of one deployment
+    shape shares one compiled executable (the fleet.py builder pattern)."""
+    from fluidframework_tpu.ops.pallas_kernel import (
+        SC_COUNT,
+        SC_CUR_SEQ,
+        SC_ERR,
+        SC_MIN_SEQ,
+        apply_ops_packed,
+    )
+
+    def per_shard(tables, scalars, ops):
+        tables, scalars = apply_ops_packed(
+            tables, scalars, ops, block_docs=blk, interpret=interpret
+        )
+        stats = {
+            "rows_in_use": jax.lax.psum(
+                jnp.sum(scalars[:, SC_COUNT]), axis
+            ),
+            "docs_with_errors": jax.lax.psum(
+                jnp.sum((scalars[:, SC_ERR] != 0).astype(jnp.int32)), axis
+            ),
+            "max_seq": jax.lax.pmax(
+                jnp.max(scalars[:, SC_CUR_SEQ]), axis
+            ),
+            "min_window": jax.lax.pmin(
+                jnp.min(scalars[:, SC_MIN_SEQ]), axis
+            ),
+        }
+        return tables, scalars, stats
+
+    return jax.jit(
+        compat_shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(P(None, axis, None), P(axis, None),
+                      P(axis, None, None)),
+            out_specs=(P(None, axis, None), P(axis, None), P()),
+        ),
+        donate_argnums=(0, 1),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _mesh_pallas_compact(mesh: Mesh, axis: str, interpret: bool):
+    from fluidframework_tpu.ops.pallas_compact import compact_packed
+
+    def per_shard(tables, scalars):
+        return compact_packed(tables, scalars, interpret=interpret)
+
+    return jax.jit(
+        compat_shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(P(None, axis, None), P(axis, None)),
+            out_specs=(P(None, axis, None), P(axis, None)),
+        ),
+        donate_argnums=(0, 1),
+    )
+
+
 class DocShard:
     """A mesh-resident fleet of documents — the compute backend the service
     layer feeds with sequenced op batches (the ``TpuDeliLambda`` target).
@@ -118,78 +188,18 @@ class DocShard:
             ss = NamedSharding(self.mesh, P(axis, None))
             self._tables = jax.device_put(tables, ts)
             self._scalars = jax.device_put(scalars, ss)
-            self._pallas_step = self._make_pallas_step()
-            self._pallas_compact = self._make_pallas_compact()
+            blk = min(32, self._docs_per_dev)
+            while self._docs_per_dev % blk != 0:
+                blk //= 2
+            self._pallas_step = _mesh_pallas_step(
+                self.mesh, axis, blk, self._interpret
+            )
+            self._pallas_compact = _mesh_pallas_compact(
+                self.mesh, axis, self._interpret
+            )
         else:
             self.state = shard_state(full, self.mesh, axis)
-            self._step = jax.jit(apply_and_stats, donate_argnums=(0,))
-
-    # -- pallas backend -------------------------------------------------------
-
-    def _make_pallas_step(self):
-        from fluidframework_tpu.ops.pallas_kernel import (
-            SC_COUNT,
-            SC_CUR_SEQ,
-            SC_ERR,
-            SC_MIN_SEQ,
-            apply_ops_packed,
-        )
-
-        axis = self.axis
-        blk = min(32, self._docs_per_dev)
-        while self._docs_per_dev % blk != 0:
-            blk //= 2
-        interpret = self._interpret
-
-        def per_shard(tables, scalars, ops):
-            tables, scalars = apply_ops_packed(
-                tables, scalars, ops, block_docs=blk, interpret=interpret
-            )
-            stats = {
-                "rows_in_use": jax.lax.psum(
-                    jnp.sum(scalars[:, SC_COUNT]), axis
-                ),
-                "docs_with_errors": jax.lax.psum(
-                    jnp.sum((scalars[:, SC_ERR] != 0).astype(jnp.int32)), axis
-                ),
-                "max_seq": jax.lax.pmax(
-                    jnp.max(scalars[:, SC_CUR_SEQ]), axis
-                ),
-                "min_window": jax.lax.pmin(
-                    jnp.min(scalars[:, SC_MIN_SEQ]), axis
-                ),
-            }
-            return tables, scalars, stats
-
-        return jax.jit(
-            compat_shard_map(
-                per_shard,
-                mesh=self.mesh,
-                in_specs=(P(None, axis, None), P(axis, None),
-                          P(axis, None, None)),
-                out_specs=(P(None, axis, None), P(axis, None), P()),
-            ),
-            donate_argnums=(0, 1),
-        )
-
-    def _make_pallas_compact(self):
-        from fluidframework_tpu.ops.pallas_compact import compact_packed
-
-        axis = self.axis
-        interpret = self._interpret
-
-        def per_shard(tables, scalars):
-            return compact_packed(tables, scalars, interpret=interpret)
-
-        return jax.jit(
-            compat_shard_map(
-                per_shard,
-                mesh=self.mesh,
-                in_specs=(P(None, axis, None), P(axis, None)),
-                out_specs=(P(None, axis, None), P(axis, None)),
-            ),
-            donate_argnums=(0, 1),
-        )
+            self._step = _jit_apply_and_stats
 
     @property
     def packed(self):

@@ -34,6 +34,7 @@ genuinely outgrows every shard keeps the sticky ERR_CAPACITY.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -172,14 +173,8 @@ def sharded_apply_ops(state: SegmentState, ops: jnp.ndarray, axis: str,
 # One jitted (step, compact) pair per (mesh, axis): jax's jit cache keys
 # on function identity, so per-instance closures would recompile identical
 # programs for every promoted document.
-_JIT_CACHE: dict = {}
-
-
+@functools.lru_cache(maxsize=None)
 def _sharded_fns(mesh: Mesh, axis: str):
-    key = (mesh, axis)
-    cached = _JIT_CACHE.get(key)
-    if cached is not None:
-        return cached
     from fluidframework_tpu.parallel.mesh import compat_shard_map
 
     n = mesh.devices.size
@@ -214,7 +209,6 @@ def _sharded_fns(mesh: Mesh, axis: str):
         ),
         donate_argnums=(0,),
     )
-    _JIT_CACHE[key] = (step_fn, compact_fn)
     return step_fn, compact_fn
 
 
@@ -259,7 +253,7 @@ class ShardedDoc:
 
     def rows_in_use(self) -> int:
         """Total live rows across shards (one small readback)."""
-        return int(np.sum(np.asarray(self.state.count)))
+        return int(np.sum(np.asarray(self.state.count)))  # graftlint: readback(stats surface: one [n_shards] count pull)
 
     def rebalance(self, trigger: float = 0.8) -> bool:
         """Host-driven shard rebalance (the DocFleet-promotion analog):
@@ -267,7 +261,7 @@ class ShardedDoc:
         document as a whole still fits, redistribute live rows into equal
         contiguous runs per shard (compact first so only live rows move).
         Returns True when a redistribution happened."""
-        counts = np.asarray(self.state.count)
+        counts = np.asarray(self.state.count)  # graftlint: readback(rebalance trigger probe: one [n_shards] count pull per flush)
         if int(counts.max()) < trigger * self.shard_cap:
             return False
         self.compact()
@@ -326,7 +320,7 @@ class ShardedDoc:
         are contiguous runs per shard, so each lane is one vectorized
         concatenate — this sits on the serving read path for promoted
         documents."""
-        h = SegmentState(*[np.asarray(x) for x in self.state])
+        h = SegmentState(*[np.asarray(x) for x in self.state])  # graftlint: readback(to_single is the promoted-doc read path: whole-doc pull by contract)
         from fluidframework_tpu.ops.segment_state import SEGMENT_LANES
         from fluidframework_tpu.protocol.constants import KIND_FREE
 
@@ -356,4 +350,4 @@ class ShardedDoc:
 
     @property
     def err(self) -> int:
-        return int(np.bitwise_or.reduce(np.asarray(self.state.err)))
+        return int(np.bitwise_or.reduce(np.asarray(self.state.err)))  # graftlint: readback(sticky-err probe: one [n_shards] err pull)

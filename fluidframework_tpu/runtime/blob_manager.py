@@ -102,8 +102,14 @@ class BlobManager:
     def gc_routes(self):
         """One graph node per binding (no out-edges); reachable only via
         handles in channel state."""
+        # Sorted: the route dict's insertion order reaches GC sweeps and
+        # summary serialization, and set order varies with the replica's
+        # insertion history — every replica must emit identical routes
+        # (graftlint determinism).
         ids = set(self.bindings) | set(self.pending) | set(self.offline)
-        return {BLOB_ROUTE_PREFIX.rstrip("/") + "/" + i: [] for i in ids}
+        return {
+            BLOB_ROUTE_PREFIX.rstrip("/") + "/" + i: [] for i in sorted(ids)
+        }
 
     def summarize(self, swept_routes=()) -> Dict[str, str]:
         swept_ids = {

@@ -434,7 +434,7 @@ class DeviceFleetBackend:
         for cap, pool in self.fleet.pools.items():
             err = errs.get(cap) if errs is not None else None
             if err is None:
-                err = np.asarray(pool.state.err)
+                err = np.asarray(pool.state.err)  # graftlint: readback(synchronous fallback when no async scan was supplied — collect_now contract)
             if len(err) < pool.n_slots:
                 err = np.concatenate(
                     [err, np.zeros(pool.n_slots - len(err), np.int32)]

@@ -108,9 +108,9 @@ from fluidframework_tpu.ops.pallas_kernel import (
     SC_CUR_SEQ,
     SC_ERR,
     SC_MIN_SEQ,
+    SC_SELF,
     apply_ops_packed,
     pack_state,
-    unpack_state,
 )
 from fluidframework_tpu.ops.segment_state import (
     SEGMENT_LANES,
@@ -225,6 +225,14 @@ def _expand_wire(buf, widths, d, k):
 _scan_slim = jax.jit(
     lambda s: jnp.stack([s[:, SC_COUNT], s[:, SC_CUR_SEQ]], axis=1)
 )
+
+# One document's packed state sliced ON DEVICE: a [L, S] table block plus
+# one scalar row cross the link, not one transfer per lane (the
+# fleet.py ``_doc_gather`` pattern; graftlint host-sync burn-down —
+# ``np.asarray(unpack_state(...)[lane][doc])`` was L+5 blocking copies).
+_doc_slice = jax.jit(lambda tables, scalars, doc: (
+    tables[:, doc], scalars[doc]
+))
 
 
 class TpuFleetService:
@@ -393,12 +401,22 @@ class TpuFleetService:
 
     def device_errors(self) -> np.ndarray:
         """Sticky per-doc kernel err lane ([D] readback — the barrier)."""
-        return np.asarray(self.scalars[:, SC_ERR])
+        return np.asarray(self.scalars[:, SC_ERR])  # graftlint: readback(the documented explicit error barrier)
 
     def doc_state(self, doc: int) -> SegmentState:
-        """One document's merge state read back to host."""
-        state = unpack_state(self.tables, self.scalars)
-        return SegmentState(*[np.asarray(x[doc]) for x in state])
+        """One document's merge state read back to host (two transfers:
+        the doc's [L, S] lane block and its scalar row)."""
+        lanes_dev, scal_dev = _doc_slice(self.tables, self.scalars, doc)
+        lanes = np.asarray(lanes_dev)  # graftlint: readback(read path: one device-side doc slice, not the fleet)
+        scal = np.asarray(scal_dev)  # graftlint: readback(rides the same doc-slice readback)
+        return SegmentState(
+            **{k: lanes[i] for i, k in enumerate(SEGMENT_LANES)},
+            count=scal[SC_COUNT],
+            min_seq=scal[SC_MIN_SEQ],
+            cur_seq=scal[SC_CUR_SEQ],
+            self_client=scal[SC_SELF],
+            err=scal[SC_ERR],
+        )
 
     def text(self, doc: int, payloads: dict) -> str:
         return materialize(self.doc_state(doc), payloads)
@@ -512,7 +530,7 @@ class _PendingSummary:
     def stage(self) -> None:
         svc = self.svc
         t0 = time.perf_counter()
-        scan = np.asarray(self._scan)  # waits on the async copy
+        scan = np.asarray(self._scan)  # graftlint: readback(waits on the copy begin started asynchronously)
         t1 = time.perf_counter()
         cur = scan[:, 1].astype(np.int64)
         backlog = cur - svc._summarized_seq
@@ -602,7 +620,7 @@ class _PendingSummary:
                 self._tables, self._scalars, jax.device_put(idx),
                 u8, m32, rows,
             )
-            return parse(np.asarray(dev), rows, padded, docs.size, u8, m32)
+            return parse(np.asarray(dev), rows, padded, docs.size, u8, m32)  # graftlint: readback(verbatim re-gather: correctness fallback when the int8 window overflowed)
 
         # host_buckets: (rows, docs, lanes=(u8, m32), enc8 [L8,nb,rows],
         #                masks [L32,nb,rows], base [L8,nb], scal [nb,S])

@@ -291,6 +291,7 @@ class HierarchicalTree(SharedObject):
             del self._pending[mark:]
             # Identity filter: equal-valued dicts from different edits must
             # not alias each other out of the submit buffer.
+            # graftlint: nondet(identity membership only; surviving order comes from _tx_buffer — the set is never iterated)
             dropped_ids = {id(op) for op in dropped}
             self._tx_buffer = [
                 op for op in self._tx_buffer if id(op) not in dropped_ids

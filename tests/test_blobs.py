@@ -113,3 +113,28 @@ def test_blob_gc_tracks_reference_revival():
     drain([a])
     clock[0] += 100
     assert len(a.summarize()["blobs"]) == 1  # survived: re-referenced
+
+
+def test_gc_routes_order_is_replica_independent():
+    """Convergence regression (graftlint determinism): gc_routes built
+    its id set via set-union, whose iteration order depends on each
+    replica's insertion history — but the route dict's order reaches the
+    GC graph and summary serialization, which must be identical on every
+    replica. The fix iterates sorted(ids)."""
+    from fluidframework_tpu.runtime.blob_manager import BlobManager
+
+    ids = [f"blob-{i}" for i in range(40)]
+
+    def build(order, split):
+        bm = BlobManager(runtime=None)
+        for j, i in enumerate(order):
+            # spread ids across the three tables; the union must still
+            # come out in one canonical order
+            (bm.bindings, bm.pending, bm.offline)[j % split][i] = "s" + i
+        return bm
+
+    a = build(ids, 3)
+    b = build(list(reversed(ids)), 2)
+    ra, rb = a.gc_routes(), b.gc_routes()
+    assert list(ra) == list(rb) == sorted(ra)
+    assert set(ra) == {"/_blobs/" + i for i in ids}

@@ -1,0 +1,459 @@
+"""graftlint (tools/graftlint): fixture-snippet unit tests per pass — at
+least one true positive and one true negative each — plus the wire-drift
+lock behavior (a mutated opframe codec must trip the fingerprint check)
+and the repo-wide CI invariant (`--check` exits 0 with an empty
+baseline)."""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tools():
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.graftlint import core  # noqa: F401
+    from tools.graftlint.passes import (
+        DeterminismPass,
+        HostSyncPass,
+        RecompileHazardPass,
+        wire_drift,
+    )
+
+    return core, HostSyncPass, RecompileHazardPass, DeterminismPass, wire_drift
+
+
+def _run_pass(pass_cls, snippet, tmp_path, relpath="fluidframework_tpu/x.py"):
+    """Run one pass over a fixture snippet; returns surviving findings
+    (pragma suppression applied, baseline not)."""
+    core = _tools()[0]
+    abspath = tmp_path / "snippet.py"
+    abspath.write_text(textwrap.dedent(snippet))
+    src = core.ModuleSource.load(str(tmp_path), "snippet.py")
+    src.path = relpath  # scopes are resolved by the runner, not the pass
+    p = pass_cls()
+    return [
+        f for f, node in p.run(src) if not src.suppressed(f, node)
+    ]
+
+
+# -- host-sync -----------------------------------------------------------------
+
+
+def test_host_sync_flags_asarray_on_device_attr(tmp_path):
+    _, HostSync, *_ = _tools()
+    findings = _run_pass(
+        HostSync,
+        """
+        import numpy as np
+
+        def stats(pool):
+            return np.asarray(pool.state.err)
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "device→host" in findings[0].message
+
+
+def test_host_sync_flags_scalarize_of_jitted_result(tmp_path):
+    _, HostSync, *_ = _tools()
+    findings = _run_pass(
+        HostSync,
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def _scan(s):
+            return s.sum()
+
+        def probe(pool):
+            dev = _scan(pool.state)
+            return int(dev)
+        """,
+        tmp_path,
+    )
+    assert [f.message.split("(")[0] for f in findings] == ["int"]
+
+
+def test_host_sync_true_negatives(tmp_path):
+    _, HostSync, *_ = _tools()
+    findings = _run_pass(
+        HostSync,
+        """
+        import numpy as np
+
+        def host_only(rows):
+            # host numpy staging is NOT a readback
+            buf = np.asarray(rows, np.int64)
+            n = int(buf.max())
+            # .shape metadata is host-resident even on device arrays
+            def shapes(pool):
+                return int(pool.state.shape[0])
+            # np.asarray result is host: downstream int() is clean
+            host = np.asarray(pool_state_like(), np.int32)
+            return n, int(host[0])
+
+        def pool_state_like():
+            return [1, 2, 3]
+        """,
+        tmp_path,
+    )
+    assert findings == []
+
+
+def test_host_sync_pragma_suppresses_with_reason(tmp_path):
+    _, HostSync, *_ = _tools()
+    findings = _run_pass(
+        HostSync,
+        """
+        import numpy as np
+
+        def stats(pool):
+            return np.asarray(pool.state.err)  # graftlint: readback(explicit stats barrier)
+        """,
+        tmp_path,
+    )
+    assert findings == []
+
+
+def test_host_sync_pragma_without_reason_does_not_suppress(tmp_path):
+    core, HostSync, *_ = _tools()
+    abspath = tmp_path / "snippet.py"
+    abspath.write_text(
+        "import numpy as np\n"
+        "def stats(pool):\n"
+        "    return np.asarray(pool.state.err)  # graftlint: readback\n"
+    )
+    src = core.ModuleSource.load(str(tmp_path), "snippet.py")
+    survivors = [
+        f for f, node in HostSync().run(src) if not src.suppressed(f, node)
+    ]
+    assert len(survivors) == 1  # reasonless pragma suppresses nothing
+    pragma_errors = core.pragma_findings(src)
+    assert len(pragma_errors) == 1
+    assert "no reason" in pragma_errors[0].message
+
+
+# -- recompile-hazard ----------------------------------------------------------
+
+
+def test_recompile_flags_jit_in_loop(tmp_path):
+    _, _, Recompile, *_ = _tools()
+    findings = _run_pass(
+        Recompile,
+        """
+        import jax
+
+        for blk in (8, 16):
+            step = jax.jit(lambda s: s)
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "inside a loop" in findings[0].message
+
+
+def test_recompile_flags_per_call_construction(tmp_path):
+    _, _, Recompile, *_ = _tools()
+    findings = _run_pass(
+        Recompile,
+        """
+        import jax
+
+        def make_step(mesh):
+            return jax.jit(lambda s: s)
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "per call" in findings[0].message
+
+
+def test_recompile_allows_cached_and_module_level(tmp_path):
+    _, _, Recompile, *_ = _tools()
+    findings = _run_pass(
+        Recompile,
+        """
+        import functools
+        import jax
+
+        _step = jax.jit(lambda s: s)  # module level: compiled once
+
+        @functools.lru_cache(maxsize=None)
+        def make_step(mesh):
+            return jax.jit(lambda s: s)  # cached builder
+
+        @jax.jit
+        def entry(tables):
+            return pl.pallas_call(kernel)(tables)  # under the jit cache
+        """,
+        tmp_path,
+    )
+    assert findings == []
+
+
+def test_recompile_flags_traced_branch_not_static(tmp_path):
+    _, _, Recompile, *_ = _tools()
+    findings = _run_pass(
+        Recompile,
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def f(x, flag):
+            if flag:          # static: fine
+                x = x + 1
+            if x.shape[0] > 2:  # shape: fine
+                x = x * 2
+            if x:             # traced: flagged
+                x = x - 1
+            return x
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "traced value" in findings[0].message
+    assert "'x'" in findings[0].message or " x " in findings[0].message
+
+
+# -- determinism ---------------------------------------------------------------
+
+
+def test_determinism_flags_set_iteration(tmp_path):
+    *_, Determinism, _ = _tools()
+    findings = _run_pass(
+        Determinism,
+        """
+        def routes(bindings, pending):
+            ids = set(bindings) | set(pending)
+            return {k: [] for k in ids}
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "no deterministic order" in findings[0].message
+
+
+def test_determinism_flags_id_keyed_set_and_sort(tmp_path):
+    *_, Determinism, _ = _tools()
+    findings = _run_pass(
+        Determinism,
+        """
+        def f(ops):
+            bad = {id(op) for op in ops}
+            ops.sort(key=lambda o: id(o))
+            return bad
+        """,
+        tmp_path,
+    )
+    assert sorted(
+        ("id()-keyed" in f.message, "sort keyed" in f.message)
+        for f in findings
+    ) == [(False, True), (True, False)]
+
+
+def test_determinism_true_negatives(tmp_path):
+    *_, Determinism, _ = _tools()
+    findings = _run_pass(
+        Determinism,
+        """
+        def g(bindings, pending):
+            ids = set(bindings) | set(pending)
+            ordered = sorted(ids)          # total order: fine
+            n = len(ids)                   # order-free fold: fine
+            hot = min(ids)                 # value-based: fine
+            for k in ordered:              # iterating the sorted list
+                n += k
+            members = set(bindings)
+            members.discard(0)             # membership only: fine
+            return n, hot
+        """,
+        tmp_path,
+    )
+    assert findings == []
+
+
+# -- wire-drift ----------------------------------------------------------------
+
+
+def _opframe_text():
+    with open(
+        os.path.join(REPO, "fluidframework_tpu/protocol/opframe.py")
+    ) as f:
+        return f.read()
+
+
+def test_wire_fingerprint_stable_under_formatting():
+    *_, wd = _tools()
+    text = _opframe_text()
+    fp1 = wd.fingerprint_source(text)
+    # whitespace/comment churn must NOT drift the fingerprint
+    fp2 = wd.fingerprint_source("# a comment\n" + text + "\n\n# tail\n")
+    assert wd.digest(fp1) == wd.digest(fp2)
+
+
+def test_wire_fingerprint_trips_on_codec_field_change():
+    *_, wd = _tools()
+    text = _opframe_text()
+    fp0 = wd.fingerprint_source(text)
+    # 1) magic constant change
+    mutated = text.replace("0x4F463152", "0x4F463153", 1)
+    assert wd.digest(wd.fingerprint_source(mutated)) != wd.digest(fp0)
+    # 2) struct layout change (a reordered/retyped pack string)
+    assert "<iiiii" in text
+    mutated = text.replace("<iiiii", "<iiiiq", 1)
+    assert wd.digest(wd.fingerprint_source(mutated)) != wd.digest(fp0)
+
+
+def test_wire_drift_gate_end_to_end(tmp_path):
+    """A codec edit without --regen-fingerprints fails; regen (with its
+    version bump) clears it."""
+    core, *_, wd = _tools()
+    from tools.graftlint import config
+    from tools.graftlint.passes import WireDriftPass
+
+    # fixture repo: one codec module + a lock generated from it
+    rel = config.CODEC_MODULES[1]  # protocol/opframe.py
+    mod_dir = tmp_path / os.path.dirname(rel)
+    mod_dir.mkdir(parents=True)
+    (tmp_path / "api-report").mkdir()
+    (mod_dir / os.path.basename(rel)).write_text(_opframe_text())
+
+    orig_root = config.REPO_ROOT
+    config.REPO_ROOT = str(tmp_path)
+    try:
+        wd.regenerate(str(tmp_path))
+        lock = wd.load_lock(str(tmp_path))
+        assert lock["modules"][rel]["version"] == 1
+
+        src = core.ModuleSource.load(str(tmp_path), rel)
+        assert list(WireDriftPass().run(src)) == []  # clean
+
+        # mutate the codec: drift must be reported
+        mutated = _opframe_text().replace("0x4F463152", "0x4F463154", 1)
+        (mod_dir / os.path.basename(rel)).write_text(mutated)
+        src = core.ModuleSource.load(str(tmp_path), rel)
+        findings = [f for f, _ in WireDriftPass().run(src)]
+        assert len(findings) == 1
+        assert "fingerprint drift" in findings[0].message
+        assert "_RAW_MAGIC" in findings[0].message
+
+        # accept: regen bumps the version and the check turns clean
+        changed = wd.regenerate(str(tmp_path))
+        assert rel in changed
+        lock = wd.load_lock(str(tmp_path))
+        assert lock["modules"][rel]["version"] == 2
+        src = core.ModuleSource.load(str(tmp_path), rel)
+        assert list(WireDriftPass().run(src)) == []
+    finally:
+        config.REPO_ROOT = orig_root
+
+
+def test_committed_lock_matches_tree():
+    """The committed wire_fingerprints.json must describe the current
+    codec sources (the mechanical half of the compat-matrix gate)."""
+    *_, wd = _tools()
+    from tools.graftlint import config
+
+    lock = wd.load_lock(REPO)["modules"]
+    assert set(lock) == set(config.CODEC_MODULES)
+    for rel, entry in lock.items():
+        with open(os.path.join(REPO, rel)) as f:
+            fp = wd.fingerprint_source(f.read(), rel)
+        assert wd.digest(fp) == entry["digest"], (
+            f"{rel} drifted from the committed fingerprint — run "
+            "python -m tools.graftlint --regen-fingerprints in the same "
+            "change that moves the wire format"
+        )
+
+
+# -- baseline + CI invariant ---------------------------------------------------
+
+
+def test_baseline_is_committed_empty():
+    with open(os.path.join(REPO, "tools/graftlint/baseline.json")) as f:
+        assert json.load(f) == []
+
+
+def test_repo_is_graftlint_clean():
+    """The CI gate: `python -m tools.graftlint --check` exits 0 on the
+    merged tree (every surviving readback carries a reasoned pragma)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--check"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_stale_baseline_entry_is_reported(tmp_path):
+    core, *_ = _tools()
+    baseline = [
+        {"rule": "host-sync", "path": "gone.py", "source_line": "x = 1"}
+    ]
+    survivors, stale = core.apply_baseline([], baseline)
+    assert survivors == []
+    assert stale == baseline
+
+
+# -- review-hardening regressions ----------------------------------------------
+
+
+def test_determinism_flags_set_consumer_in_for_header(tmp_path):
+    """`for k in list(ids):` hides the set inside a call in the loop
+    header — the consumer check must still see it."""
+    *_, Determinism, _ = _tools()
+    findings = _run_pass(
+        Determinism,
+        """
+        def f(ids_in):
+            ids = set(ids_in)
+            out = []
+            for k in list(ids):
+                out.append(k)
+            for j, k in enumerate(ids, 1):
+                out.append((j, k))
+            return out
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 2
+    assert all("set" in f.message for f in findings)
+
+
+def test_baseline_entries_suppress_one_occurrence_each():
+    """A copy-pasted duplicate of a baselined line is a NEW finding."""
+    core = _tools()[0]
+    f = dict(rule="host-sync", path="a.py", col=1,
+             message="m", source_line="x = np.asarray(pool.state.err)")
+    findings = [
+        core.Finding(line=10, **f),
+        core.Finding(line=20, **f),
+    ]
+    baseline = [findings[0].baseline_key()]
+    survivors, stale = core.apply_baseline(findings, baseline)
+    assert len(survivors) == 1 and survivors[0].line == 20
+    assert stale == []
+
+
+def test_scope_files_matches_outside_package(tmp_path):
+    """Scope globs are repo-root-relative: a pattern outside
+    fluidframework_tpu/ must match files, not silently cover nothing."""
+    core = _tools()[0]
+    (tmp_path / "tools").mkdir()
+    (tmp_path / "tools" / "x.py").write_text("a = 1\n")
+    (tmp_path / "fluidframework_tpu").mkdir()
+    (tmp_path / "fluidframework_tpu" / "y.py").write_text("b = 2\n")
+    got = core.scope_files(
+        str(tmp_path), ("tools/*.py", "fluidframework_tpu/*.py")
+    )
+    assert got == ["fluidframework_tpu/y.py", "tools/x.py"]

@@ -116,3 +116,21 @@ def test_pallas_backend_matches_xla_on_mesh():
             np.asarray(getattr(a.state, k)), np.asarray(getattr(ub, k)),
             err_msg=k,
         )
+
+
+def test_docshard_step_functions_shared_across_instances():
+    """Recompile regression (graftlint recompile-hazard): DocShard built
+    its jitted step per instance, so every new shard of the same
+    deployment shape re-traced an identical program. The builders are now
+    module-level/cached — two same-shape shards must share the SAME
+    compiled callables."""
+    from fluidframework_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    a = DocShard(n_docs=16, capacity=16, mesh=mesh, backend="xla")
+    b = DocShard(n_docs=16, capacity=16, mesh=mesh, backend="xla")
+    assert a._step is b._step
+    p = DocShard(n_docs=16, capacity=16, mesh=mesh, backend="pallas")
+    q = DocShard(n_docs=16, capacity=16, mesh=mesh, backend="pallas")
+    assert p._pallas_step is q._pallas_step
+    assert p._pallas_compact is q._pallas_compact

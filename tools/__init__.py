@@ -1,0 +1,2 @@
+# Repo tooling namespace (makes `python -m tools.graftlint` runnable
+# from the repo root, the same way CI invokes it).

@@ -1,0 +1,32 @@
+"""graftlint — repo-native static analysis for the TPU serving paths.
+
+The paper's contract is twofold: every client deterministically merges a
+totally-ordered op stream into identical state, and this repro's merge
+hot paths run as batched device kernels. Neither contract is visible to
+a generic linter — flake8 cannot know that ``np.asarray(pool.state.err)``
+is a device→host transfer on a serving path, that iterating a ``set()``
+in a merge module breaks the identical-replica guarantee, or that a
+reordered ``struct.pack`` format silently strands every N-1 reader.
+
+graftlint is the AST-based suite that does know. Four passes:
+
+- **host-sync** — implicit device→host transfers in device-path modules
+  (``.item()``, ``int()``/``float()``/``bool()`` on device values,
+  ``np.asarray``/``np.array`` on jax values, ``block_until_ready``);
+  every intentional readback carries ``# graftlint: readback(<reason>)``.
+- **recompile-hazard** — ``jax.jit``/``pallas_call`` construction inside
+  loops or uncached per-call functions, and Python branches on traced
+  values inside jitted functions.
+- **determinism** — unordered ``set`` iteration, ``id()``-keyed ordering,
+  and ``id()``/``hash()`` sort keys in merge/sequencing modules.
+- **wire-drift** — field/layout fingerprints of the codec modules locked
+  in ``api-report/wire_fingerprints.json``; a codec change without a
+  version bump fails CI.
+
+Run ``python -m tools.graftlint --check`` (the CI gate) or see
+``tools/graftlint/README.md``.
+"""
+
+from tools.graftlint.core import Finding, run  # noqa: F401
+
+__all__ = ["Finding", "run"]

@@ -1,0 +1,96 @@
+"""CLI: ``python -m tools.graftlint [--check] [paths...]``.
+
+Modes:
+  (default / --check)    run all passes, subtract the committed baseline,
+                         exit 1 on any finding (the CI gate)
+  --regen-fingerprints   accept intentional codec changes: rewrite
+                         api-report/wire_fingerprints.json, bumping the
+                         version of every drifted module
+  --write-baseline       snapshot current findings into the baseline
+                         (burn-down staging INSIDE a PR only — the
+                         committed baseline must be empty at merge)
+  --passes a,b           restrict to a subset of pass ids
+  --no-baseline          report everything, ignoring the baseline
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.graftlint import config, core
+from tools.graftlint.passes import ALL_PASSES, wire_drift
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.graftlint")
+    ap.add_argument("paths", nargs="*", help="repo-relative file filters")
+    ap.add_argument("--check", action="store_true",
+                    help="run all passes (the default; explicit for CI)")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated pass ids "
+                         f"({', '.join(p.id for p in ALL_PASSES)})")
+    ap.add_argument("--regen-fingerprints", action="store_true",
+                    help="rewrite the wire fingerprint lock (+version "
+                         "bumps) for intentional codec changes")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="snapshot current findings into the baseline")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the committed baseline")
+    args = ap.parse_args(argv)
+
+    root = config.REPO_ROOT
+    if args.regen_fingerprints:
+        changed = wire_drift.regenerate(root)
+        if changed:
+            print("graftlint: fingerprints regenerated for: "
+                  + ", ".join(changed))
+        else:
+            print("graftlint: fingerprints already current")
+        return 0
+
+    passes = args.passes.split(",") if args.passes else None
+    known = {p.id for p in ALL_PASSES}
+    if passes and not set(passes) <= known:
+        print(f"graftlint: unknown pass(es) {set(passes) - known}",
+              file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        findings, _ = core.run(root, passes=passes, paths=args.paths or None,
+                               use_baseline=False)
+        path = os.path.join(root, config.BASELINE_FILE)
+        with open(path, "w") as f:
+            json.dump([fi.baseline_key() for fi in findings], f, indent=1)
+            f.write("\n")
+        print(f"graftlint: baselined {len(findings)} finding(s) — the "
+              "committed baseline must be empty at merge")
+        return 0
+
+    findings, stale = core.run(
+        root,
+        passes=passes,
+        paths=args.paths or None,
+        use_baseline=not args.no_baseline,
+    )
+    for f in findings:
+        print(f.render())
+    for e in stale:
+        print(
+            f"{e['path']}: [baseline] stale baseline entry for "
+            f"{e['rule']!r} ({e['source_line'][:60]!r}) — remove it from "
+            f"{config.BASELINE_FILE}"
+        )
+    n = len(findings) + len(stale)
+    if n:
+        print(f"graftlint: {len(findings)} finding(s), "
+              f"{len(stale)} stale baseline entrie(s)")
+        return 1
+    print("graftlint: clean (4 passes, empty baseline)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,70 @@
+"""Per-pass scope and heuristics configuration.
+
+graftlint is deliberately repo-native: the scopes below name THIS
+codebase's device paths, merge paths, and codec modules, and the taint
+heuristics name its device-state attribute idioms. Generic linters stop
+where type information ends; a repo-native one gets to encode what the
+repo already promises in its docstrings (``pool.state`` lives on device,
+``fluidframework_tpu.ops`` functions return device values, ...).
+
+All paths are repo-root-relative POSIX globs.
+"""
+
+from __future__ import annotations
+
+import os
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# Device-path modules (the host-sync + recompile-hazard scope): code that
+# sits between the service front door and the kernels, where an
+# unannotated device→host transfer is a serving-latency bug.
+DEVICE_PATH_SCOPE = (
+    "fluidframework_tpu/ops/*.py",
+    "fluidframework_tpu/tree/device_*.py",
+    "fluidframework_tpu/parallel/*.py",
+    "fluidframework_tpu/service/device_backend.py",
+    "fluidframework_tpu/service/fleet_service.py",
+)
+
+# Merge/sequencing modules (the determinism scope): code every replica
+# runs over the sequenced stream — any iteration-order dependence here is
+# the bug class that breaks the identical-replica guarantee.
+MERGE_PATH_SCOPE = (
+    "fluidframework_tpu/tree/*.py",
+    "fluidframework_tpu/ops/*.py",
+    "fluidframework_tpu/service/sequencer.py",
+    "fluidframework_tpu/service/pipeline.py",
+    "fluidframework_tpu/runtime/*.py",
+    "fluidframework_tpu/models/*.py",
+)
+
+# Codec modules (the wire-drift scope): every accreting format ROADMAP
+# names — kernel-row field layout, op frames, log values, binary
+# snapshots, the tree move wire, and the scribe lane layout.
+CODEC_MODULES = (
+    "fluidframework_tpu/protocol/constants.py",
+    "fluidframework_tpu/protocol/opframe.py",
+    "fluidframework_tpu/service/codec.py",
+    "fluidframework_tpu/drivers/binary_snapshot.py",
+    "fluidframework_tpu/tree/marks.py",
+    "fluidframework_tpu/ops/segment_state.py",
+)
+
+# Attribute names that denote device-resident state in this codebase
+# (``pool.state``, ``self.tables``, ``svc._scalars``, ...). An attribute
+# access whose terminal name is in this set taints the expression as a
+# device value for the host-sync pass.
+DEVICE_ATTRS = frozenset(
+    {"state", "tables", "scalars", "_tables", "_scalars", "_scan"}
+)
+
+# Imports from these module prefixes are assumed to RETURN device values
+# (the kernel entry points: apply_ops_packed, unpack_state, ...).
+KERNEL_MODULE_PREFIXES = ("fluidframework_tpu.ops",)
+
+# Committed artifacts.
+WIRE_LOCK_FILE = "api-report/wire_fingerprints.json"
+BASELINE_FILE = "tools/graftlint/baseline.json"

@@ -1,0 +1,335 @@
+"""Shared graftlint infrastructure: findings, sources, pragmas, baseline,
+and the pass runner.
+
+Pragma syntax (inline suppression with a MANDATORY reason)::
+
+    x = np.asarray(dev)  # graftlint: readback(scribe transfer wait)
+
+    # graftlint: nondet(identity membership only; order never observed)
+    dropped_ids = {id(op) for op in dropped}
+
+A pragma suppresses findings of its rule on its own physical line, on any
+line of the flagged statement's span, or — for a comment-only line — on
+the statement that starts on the next line. A pragma with no reason is
+itself a finding: the whole point is that every suppression documents WHY
+the contract is intentionally bent.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import io
+import json
+import os
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tools.graftlint import config
+
+# rule id -> pragma name that suppresses it (wire-drift has no pragma: the
+# lock file + version bump is its acceptance mechanism).
+PRAGMA_OF_RULE = {
+    "host-sync": "readback",
+    "recompile-hazard": "recompile",
+    "determinism": "nondet",
+}
+KNOWN_PRAGMAS = frozenset(PRAGMA_OF_RULE.values())
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str  # pass id ("host-sync", "determinism", ...)
+    path: str  # repo-relative POSIX path
+    line: int
+    col: int
+    message: str
+    source_line: str = ""  # stripped text at `line` (baseline key)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def baseline_key(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "source_line": self.source_line,
+        }
+
+
+@dataclass
+class Pragma:
+    line: int
+    name: str
+    reason: str
+    comment_only: bool  # pragma sits on a comment-only line
+
+
+@dataclass
+class ModuleSource:
+    """One parsed source file plus its pragma table."""
+
+    path: str  # repo-relative POSIX
+    abspath: str
+    text: str
+    tree: ast.AST
+    lines: List[str] = field(default_factory=list)
+    pragmas: List[Pragma] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, root: str, relpath: str) -> "ModuleSource":
+        abspath = os.path.join(root, relpath)
+        with open(abspath, encoding="utf-8") as f:
+            text = f.read()
+        src = cls(
+            path=relpath.replace(os.sep, "/"),
+            abspath=abspath,
+            text=text,
+            tree=ast.parse(text, filename=relpath),
+            lines=text.splitlines(),
+        )
+        src.pragmas = _collect_pragmas(text)
+        return src
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(
+        self, rule: str, node: ast.AST, message: str
+    ) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0) + 1
+        return Finding(
+            rule=rule,
+            path=self.path,
+            line=line,
+            col=col,
+            message=message,
+            source_line=self.line_text(line),
+        )
+
+    def suppressed(self, finding: Finding, node: ast.AST) -> bool:
+        """True when a reasoned pragma of the finding's rule covers the
+        node's statement span."""
+        name = PRAGMA_OF_RULE.get(finding.rule)
+        if name is None:
+            return False
+        lo = getattr(node, "lineno", finding.line)
+        hi = getattr(node, "end_lineno", lo) or lo
+        for p in self.pragmas:
+            if p.name != name or not p.reason.strip():
+                continue
+            if lo <= p.line <= hi:
+                return True
+            if p.comment_only and p.line == lo - 1:
+                return True
+        return False
+
+
+def _collect_pragmas(text: str) -> List[Pragma]:
+    """Pragmas via the tokenizer (a ``# graftlint:`` inside a string
+    literal is not a pragma)."""
+    out: List[Pragma] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(text).readline))
+    except tokenize.TokenError:  # pragma: no cover - unparsable source
+        return out
+    code_lines = set()
+    for tok in tokens:
+        if tok.type in (
+            tokenize.COMMENT,
+            tokenize.NL,
+            tokenize.NEWLINE,
+            tokenize.INDENT,
+            tokenize.DEDENT,
+            tokenize.ENDMARKER,
+        ):
+            continue
+        for ln in range(tok.start[0], tok.end[0] + 1):
+            code_lines.add(ln)
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        body = tok.string.lstrip("#").strip()
+        if not body.startswith("graftlint:"):
+            continue
+        spec = body[len("graftlint:"):].strip()
+        name, _, rest = spec.partition("(")
+        reason = rest[:-1] if rest.endswith(")") else rest
+        out.append(
+            Pragma(
+                line=tok.start[0],
+                name=name.strip(),
+                reason=reason.strip(),
+                comment_only=tok.start[0] not in code_lines,
+            )
+        )
+    return out
+
+
+def pragma_findings(src: ModuleSource) -> List[Finding]:
+    """Malformed pragmas are findings themselves: unknown names (typos
+    silently suppress nothing) and missing reasons (undocumented
+    suppressions defeat the audit trail)."""
+    out: List[Finding] = []
+    for p in src.pragmas:
+        if p.name not in KNOWN_PRAGMAS:
+            out.append(
+                Finding(
+                    rule="pragma",
+                    path=src.path,
+                    line=p.line,
+                    col=1,
+                    message=(
+                        f"unknown graftlint pragma {p.name!r} "
+                        f"(known: {', '.join(sorted(KNOWN_PRAGMAS))})"
+                    ),
+                    source_line=src.line_text(p.line),
+                )
+            )
+        elif not p.reason.strip():
+            out.append(
+                Finding(
+                    rule="pragma",
+                    path=src.path,
+                    line=p.line,
+                    col=1,
+                    message=(
+                        f"graftlint pragma {p.name!r} has no reason — "
+                        f"write `# graftlint: {p.name}(<why this is "
+                        "intentional>)`"
+                    ),
+                    source_line=src.line_text(p.line),
+                )
+            )
+    return out
+
+
+# -- scope resolution ----------------------------------------------------------
+
+
+_SKIP_DIRS = frozenset({".git", "__pycache__", ".claude", "node_modules"})
+
+
+def scope_files(root: str, patterns: Sequence[str]) -> List[str]:
+    """Repo-relative files matching any scope glob, sorted for stable
+    output order. Walks the whole repo (pruning VCS/cache dirs) so scope
+    patterns outside the package match too — a CI gate whose scope
+    silently matched nothing would report clean while covering nothing."""
+    out = set()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fn), root)
+            rel = rel.replace(os.sep, "/")
+            if any(fnmatch.fnmatch(rel, pat) for pat in patterns):
+                out.add(rel)
+    return sorted(out)
+
+
+# -- baseline ------------------------------------------------------------------
+
+
+def load_baseline(root: str) -> List[dict]:
+    path = os.path.join(root, config.BASELINE_FILE)
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return json.load(f)
+
+
+def apply_baseline(
+    findings: List[Finding], baseline: List[dict]
+) -> Tuple[List[Finding], List[dict]]:
+    """(surviving findings, stale baseline entries). A baseline entry
+    matches by (rule, path, source line text) so findings survive line
+    drift, and each entry suppresses ONE occurrence — a copy-pasted
+    duplicate of a baselined line is a NEW finding, not covered. The
+    committed baseline must be empty at merge — it exists only to stage
+    burn-downs inside a PR."""
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for e in baseline:
+        k = (e["rule"], e["path"], e["source_line"])
+        budget[k] = budget.get(k, 0) + 1
+    survivors = []
+    for f in findings:
+        k = (f.rule, f.path, f.source_line)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            survivors.append(f)
+    stale = []
+    for e in baseline:
+        k = (e["rule"], e["path"], e["source_line"])
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            stale.append(e)
+    return survivors, stale
+
+
+# -- runner --------------------------------------------------------------------
+
+
+def run(
+    root: Optional[str] = None,
+    passes: Optional[Iterable[str]] = None,
+    paths: Optional[Sequence[str]] = None,
+    use_baseline: bool = True,
+) -> Tuple[List[Finding], List[dict]]:
+    """Run the selected passes over their configured scopes.
+
+    Returns (findings, stale_baseline_entries). ``paths`` additionally
+    filters every pass's scope to the given repo-relative files (fast
+    pre-commit loops).
+    """
+    from tools.graftlint.passes import ALL_PASSES
+
+    root = root or config.REPO_ROOT
+    selected = [
+        p
+        for p in ALL_PASSES
+        if passes is None or p.id in set(passes)
+    ]
+    findings: List[Finding] = []
+    seen_files = set()
+    src_cache: Dict[str, ModuleSource] = {}
+
+    def get_src(rel: str) -> Optional[ModuleSource]:
+        if rel not in src_cache:
+            try:
+                src_cache[rel] = ModuleSource.load(root, rel)
+            except (OSError, SyntaxError) as e:
+                src_cache[rel] = None  # type: ignore[assignment]
+                findings.append(
+                    Finding(
+                        rule="parse",
+                        path=rel,
+                        line=1,
+                        col=1,
+                        message=f"cannot analyze: {e}",
+                    )
+                )
+        return src_cache[rel]
+
+    for p in selected:
+        for rel in p.scope(root):
+            if paths and rel not in paths:
+                continue
+            src = get_src(rel)
+            if src is None:
+                continue
+            if rel not in seen_files:
+                seen_files.add(rel)
+                findings.extend(pragma_findings(src))
+            for f, node in p.run(src):
+                if not src.suppressed(f, node):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    if use_baseline:
+        return apply_baseline(findings, load_baseline(root))
+    return findings, []

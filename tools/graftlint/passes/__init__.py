@@ -1,0 +1,24 @@
+"""Pass registry. Each pass exposes ``id``, ``scope(root)`` (the
+repo-relative files it covers), and ``run(src)`` yielding
+``(Finding, flagged_node)`` pairs — the node carries the statement span
+pragma suppression checks against."""
+
+from tools.graftlint.passes.determinism import DeterminismPass
+from tools.graftlint.passes.host_sync import HostSyncPass
+from tools.graftlint.passes.recompile import RecompileHazardPass
+from tools.graftlint.passes.wire_drift import WireDriftPass
+
+ALL_PASSES = (
+    HostSyncPass(),
+    RecompileHazardPass(),
+    DeterminismPass(),
+    WireDriftPass(),
+)
+
+__all__ = [
+    "ALL_PASSES",
+    "DeterminismPass",
+    "HostSyncPass",
+    "RecompileHazardPass",
+    "WireDriftPass",
+]

@@ -1,0 +1,349 @@
+"""determinism: iteration-order hazards in merge/sequencing modules.
+
+Every replica folds the same totally-ordered op stream into state; the
+paper's guarantee is that the folds are IDENTICAL. Python hands out two
+footguns that break this silently:
+
+- ``set``/``frozenset`` iteration order depends on insertion history and
+  hash seeding — two replicas that built the same set along different
+  paths iterate it differently. Flagged wherever a set-typed value is
+  iterated (``for``, comprehensions, ``list()``/``tuple()``/
+  ``enumerate()``/``join()``/``map()``/``filter()``); order-independent
+  folds (``sorted``/``min``/``max``/``sum``/``any``/``all``/``len``) are
+  exempt.
+- ``id()`` is a per-process address: any ordering keyed on it
+  (``sorted(key=id)``, ``{id(x): ...}``, ``{id(x) for x}``) diverges
+  across replicas by construction. ``hash()`` sort keys are flagged for
+  the same reason (str hashes are salted per process).
+
+Set-typedness is inferred locally (assignments from ``set()``/
+``frozenset()``/set literals/set comprehensions, and ``self.X`` attrs
+assigned a set anywhere in the same class). Intentional uses —
+membership-only structures whose order is never observed — carry
+``# graftlint: nondet(<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.graftlint import config
+from tools.graftlint.core import Finding, ModuleSource, scope_files
+
+# Consumers whose result ORDER exposes the iterable's order.
+_ORDER_SENSITIVE = ("list", "tuple", "enumerate", "map", "filter", "iter",
+                    "reversed")
+# Order-independent folds: iterating a set through these is sound.
+_ORDER_FREE = ("sorted", "min", "max", "sum", "any", "all", "len",
+               "frozenset", "set")
+
+
+def _is_set_expr(node: ast.AST, env: Dict[str, bool],
+                 attrs: Set[str]) -> bool:
+    """Conservative set-typedness: literal constructors, known locals,
+    and known self attributes."""
+    if isinstance(node, ast.Set) or isinstance(node, ast.SetComp):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        if node.func.id in ("set", "frozenset"):
+            return True
+    if isinstance(node, ast.Name):
+        return env.get(node.id, False)
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr in attrs
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.BitAnd, ast.BitOr, ast.BitXor, ast.Sub)
+    ):
+        # set algebra yields sets
+        return _is_set_expr(node.left, env, attrs) or _is_set_expr(
+            node.right, env, attrs
+        )
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if node.func.attr in (
+            "union", "intersection", "difference", "symmetric_difference",
+            "copy",
+        ):
+            return _is_set_expr(node.func.value, env, attrs)
+    return False
+
+
+def _set_attrs_of_classes(tree: ast.AST) -> Dict[str, Set[str]]:
+    """class name -> self attributes assigned a set anywhere in it."""
+    out: Dict[str, Set[str]] = {}
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        attrs: Set[str] = set()
+        for node in ast.walk(cls):
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None or not _is_set_expr(value, {}, set()):
+                continue
+            for t in targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    attrs.add(t.attr)
+        out[cls.name] = attrs
+    return out
+
+
+def _contains_id_call(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Name)
+            and sub.func.id in ("id", "hash")
+        ):
+            return True
+        if isinstance(sub, ast.Name) and sub.id in ("id", "hash"):
+            # bare `key=id`
+            return True
+    return False
+
+
+class DeterminismPass:
+    id = "determinism"
+
+    def scope(self, root: str) -> List[str]:
+        return scope_files(root, config.MERGE_PATH_SCOPE)
+
+    def run(self, src: ModuleSource) -> Iterator[Tuple[Finding, ast.AST]]:
+        class_attrs = _set_attrs_of_classes(src.tree)
+        yield from self._walk_scope(
+            src, src.tree.body, env={}, attrs=set(), class_attrs=class_attrs
+        )
+
+    def _walk_scope(
+        self,
+        src: ModuleSource,
+        body: List[ast.stmt],
+        env: Dict[str, bool],
+        attrs: Set[str],
+        class_attrs: Dict[str, Set[str]],
+    ) -> Iterator[Tuple[Finding, ast.AST]]:
+        for stmt in body:
+            if isinstance(stmt, ast.ClassDef):
+                yield from self._walk_scope(
+                    src,
+                    stmt.body,
+                    {},
+                    class_attrs.get(stmt.name, set()),
+                    class_attrs,
+                )
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._walk_scope(
+                    src, stmt.body, {}, attrs, class_attrs
+                )
+                continue
+            yield from self._check_stmt(src, stmt, env, attrs)
+            # order matters: bindings update after the check
+            if isinstance(stmt, ast.Assign):
+                is_set = _is_set_expr(stmt.value, env, attrs)
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        env[t.id] = is_set
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                if stmt.value is not None:
+                    env[stmt.target.id] = _is_set_expr(
+                        stmt.value, env, attrs
+                    )
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                yield from self._walk_scope(
+                    src, stmt.body, env, attrs, class_attrs
+                )
+                yield from self._walk_scope(
+                    src, stmt.orelse, env, attrs, class_attrs
+                )
+            elif isinstance(stmt, (ast.If, ast.While)):
+                yield from self._walk_scope(
+                    src, stmt.body, env, attrs, class_attrs
+                )
+                yield from self._walk_scope(
+                    src, stmt.orelse, env, attrs, class_attrs
+                )
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                yield from self._walk_scope(
+                    src, stmt.body, env, attrs, class_attrs
+                )
+            elif isinstance(stmt, ast.Try):
+                for blk in (stmt.body, stmt.orelse, stmt.finalbody):
+                    yield from self._walk_scope(
+                        src, blk, env, attrs, class_attrs
+                    )
+                for h in stmt.handlers:
+                    yield from self._walk_scope(
+                        src, h.body, env, attrs, class_attrs
+                    )
+
+    def _check_stmt(
+        self,
+        src: ModuleSource,
+        stmt: ast.stmt,
+        env: Dict[str, bool],
+        attrs: Set[str],
+    ) -> Iterator[Tuple[Finding, ast.AST]]:
+        # for-loop over a set (header only; bodies re-enter _walk_scope)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)) and _is_set_expr(
+            stmt.iter, env, attrs
+        ):
+            yield (
+                src.finding(
+                    self.id,
+                    stmt.iter,
+                    f"iterating set-typed {ast.unparse(stmt.iter)!r} has "
+                    "no deterministic order — replicas diverge; iterate "
+                    "sorted(...) with a total-order key or annotate "
+                    "`# graftlint: nondet(<reason>)`",
+                ),
+                stmt,
+            )
+        roots: List[ast.AST]
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            # Body statements re-enter _walk_scope; the header expression
+            # still needs the consumer checks (`for k in list(ids):` hides
+            # the set inside a call the direct check above can't see).
+            roots = [stmt.iter]
+        elif isinstance(stmt, (ast.If, ast.While)):
+            roots = [stmt.test]
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            roots = [i.context_expr for i in stmt.items]
+        elif isinstance(stmt, ast.Try):
+            roots = []
+        else:
+            roots = [stmt]
+        for root in roots:
+            for node in ast.walk(root):
+                yield from self._check_expr_node(src, node, stmt, env, attrs)
+
+    def _check_expr_node(
+        self,
+        src: ModuleSource,
+        node: ast.AST,
+        stmt: ast.stmt,
+        env: Dict[str, bool],
+        attrs: Set[str],
+    ) -> Iterator[Tuple[Finding, ast.AST]]:
+        # comprehension over a set
+        if isinstance(
+            node, (ast.ListComp, ast.GeneratorExp, ast.SetComp, ast.DictComp)
+        ):
+            for gen in node.generators:
+                if isinstance(node, ast.SetComp):
+                    continue  # building a set: order of construction moot
+                if _is_set_expr(gen.iter, env, attrs):
+                    yield (
+                        src.finding(
+                            self.id,
+                            gen.iter,
+                            "comprehension over set-typed "
+                            f"{ast.unparse(gen.iter)!r} has no "
+                            "deterministic order — iterate sorted(...) "
+                            "or annotate `# graftlint: nondet(<reason>)`",
+                        ),
+                        stmt,
+                    )
+            # id()-keyed set/dict comprehensions
+            if isinstance(node, ast.SetComp) and _contains_id_call(node.elt):
+                yield (
+                    src.finding(
+                        self.id,
+                        node,
+                        "id()-keyed set: process-local addresses can "
+                        "never order consistently across replicas — key "
+                        "on a stable identity or annotate "
+                        "`# graftlint: nondet(<reason>)`",
+                    ),
+                    stmt,
+                )
+            if isinstance(node, ast.DictComp) and _contains_id_call(node.key):
+                yield (
+                    src.finding(
+                        self.id,
+                        node,
+                        "id()-keyed dict: process-local addresses can "
+                        "never order consistently across replicas — key "
+                        "on a stable identity or annotate "
+                        "`# graftlint: nondet(<reason>)`",
+                    ),
+                    stmt,
+                )
+            return
+        if isinstance(node, ast.Dict):
+            if any(k is not None and _contains_id_call(k) for k in node.keys):
+                yield (
+                    src.finding(
+                        self.id,
+                        node,
+                        "id()-keyed dict literal: process-local addresses "
+                        "can never order consistently across replicas — "
+                        "key on a stable identity or annotate "
+                        "`# graftlint: nondet(<reason>)`",
+                    ),
+                    stmt,
+                )
+            return
+        if not isinstance(node, ast.Call):
+            return
+        f = node.func
+        # order-sensitive consumers of sets (ANY positional arg: the set
+        # sits at args[0] for enumerate(ids, 1), args[1] for map(f, ids))
+        if isinstance(f, ast.Name) and f.id in _ORDER_SENSITIVE:
+            if any(_is_set_expr(a, env, attrs) for a in node.args):
+                yield (
+                    src.finding(
+                        self.id,
+                        node,
+                        f"{f.id}() over a set exposes nondeterministic "
+                        "order — wrap in sorted(...) with a total-order "
+                        "key or annotate `# graftlint: nondet(<reason>)`",
+                    ),
+                    stmt,
+                )
+        # "sep".join(set)
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr == "join"
+            and node.args
+            and _is_set_expr(node.args[0], env, attrs)
+        ):
+            yield (
+                src.finding(
+                    self.id,
+                    node,
+                    "join() over a set concatenates in nondeterministic "
+                    "order — sort first",
+                ),
+                stmt,
+            )
+        # id()/hash() sort keys
+        is_sort = (
+            isinstance(f, ast.Name) and f.id in ("sorted", "min", "max")
+        ) or (isinstance(f, ast.Attribute) and f.attr == "sort")
+        if is_sort:
+            for kw in node.keywords:
+                if kw.arg == "key" and _contains_id_call(kw.value):
+                    yield (
+                        src.finding(
+                            self.id,
+                            node,
+                            "sort keyed on id()/hash(): process-local "
+                            "values break the total order replicas must "
+                            "share — use a sequenced/stable key",
+                        ),
+                        stmt,
+                    )

@@ -1,0 +1,257 @@
+"""wire-drift: codec field/layout fingerprints locked against a
+committed file.
+
+ROADMAP calls the accreting wire formats (planar wire, pack blobs, int8
+lanes, frames, move wire) "the biggest structural risk": formats gain
+fields every round and nothing mechanical noticed when one changed. This
+pass extracts a STATIC fingerprint from each codec module's AST —
+
+- module-level layout constants (``_RAW_MAGIC``, ``_T_*`` tags, ``F_*``
+  field indices, ``MARK_KINDS``, ``SEGMENT_LANES``, ...): name → literal
+  value; non-literal constants (e.g. codec type registries) record their
+  sorted keys/element names;
+- every ``struct.pack``/``unpack``/``unpack_from``/``pack_into``/
+  ``calcsize``/``Struct`` format string (byte layout in one token);
+- ``__slots__`` tuples and ``@dataclass`` field orders (wire-visible
+  attribute order);
+
+— and compares it against ``api-report/wire_fingerprints.json``. Any
+drift fails ``--check``. An INTENTIONAL format change is accepted by
+``python -m tools.graftlint --regen-fingerprints``, which rewrites the
+fingerprint and bumps that module's version — so the committed diff
+shows the bump, review sees it, and the matching golden fixture
+(e.g. ``tests/goldens/golden_move_wire.json``) must move in the same PR.
+There is no inline pragma for this pass: the lock file IS the
+suppression, and it leaves an audit trail.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from typing import Dict, Iterator, List, Tuple
+
+from tools.graftlint import config
+from tools.graftlint.core import Finding, ModuleSource
+
+_CONST_NAME = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+_STRUCT_FNS = ("pack", "unpack", "unpack_from", "pack_into", "calcsize",
+               "Struct", "iter_unpack")
+
+
+def _const_value(node: ast.AST) -> object:
+    """Literal repr for a constant's value; containers of non-literals
+    degrade to their stable shape (dict keys / element names)."""
+    try:
+        return repr(ast.literal_eval(node))
+    except ValueError:
+        pass
+    if isinstance(node, ast.Dict):
+        keys = []
+        for k in node.keys:
+            try:
+                keys.append(repr(ast.literal_eval(k)))
+            except ValueError:
+                keys.append(ast.unparse(k) if k is not None else "**")
+        return {"keys": sorted(keys)}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return {"elts": [ast.unparse(e) for e in node.elts]}
+    return {"expr": ast.unparse(node)}
+
+
+def fingerprint_source(text: str, filename: str = "<codec>") -> dict:
+    """The static wire fingerprint of one codec module's source."""
+    tree = ast.parse(text, filename=filename)
+    constants: Dict[str, object] = {}
+    for stmt in tree.body:
+        targets: List[ast.AST] = []
+        value = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if value is None:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and _CONST_NAME.match(t.id):
+                constants[t.id] = _const_value(value)
+    struct_formats: List[str] = []
+    slots: Dict[str, object] = {}
+    dataclass_fields: Dict[str, List[str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _STRUCT_FNS
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "struct"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                struct_formats.append(node.args[0].value)
+        elif isinstance(node, ast.ClassDef):
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and any(
+                        isinstance(t, ast.Name) and t.id == "__slots__"
+                        for t in stmt.targets
+                    )
+                ):
+                    slots[node.name] = _const_value(stmt.value)
+            if any(
+                (isinstance(d, ast.Name) and d.id == "dataclass")
+                or (
+                    isinstance(d, ast.Call)
+                    and isinstance(d.func, ast.Name)
+                    and d.func.id == "dataclass"
+                )
+                or (isinstance(d, ast.Attribute) and d.attr == "dataclass")
+                for d in node.decorator_list
+            ):
+                dataclass_fields[node.name] = [
+                    s.target.id
+                    for s in node.body
+                    if isinstance(s, ast.AnnAssign)
+                    and isinstance(s.target, ast.Name)
+                ]
+    return {
+        "constants": constants,
+        "struct_formats": sorted(struct_formats),
+        "slots": slots,
+        "dataclass_fields": dataclass_fields,
+    }
+
+
+def digest(fp: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(fp, sort_keys=True).encode()
+    ).hexdigest()
+
+
+def load_lock(root: str) -> dict:
+    path = os.path.join(root, config.WIRE_LOCK_FILE)
+    if not os.path.exists(path):
+        return {"modules": {}}
+    with open(path) as f:
+        return json.load(f)
+
+
+def _diff_keys(old: dict, new: dict) -> List[str]:
+    out = []
+    for section in ("constants", "struct_formats", "slots",
+                    "dataclass_fields"):
+        a, b = old.get(section), new.get(section)
+        if a == b:
+            continue
+        if isinstance(a, dict) and isinstance(b, dict):
+            changed = sorted(
+                k
+                for k in set(a) | set(b)
+                if a.get(k) != b.get(k)
+            )
+            out.append(f"{section}: {', '.join(changed)}")
+        else:
+            out.append(section)
+    return out
+
+
+def regenerate(root: str) -> List[str]:
+    """Recompute every configured module's fingerprint; bump versions for
+    changed ones; write the lock file. Returns the changed module list."""
+    lock = load_lock(root)
+    modules = lock.get("modules", {})
+    changed = []
+    for rel in config.CODEC_MODULES:
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            continue  # scope() skips absent modules too (fixture trees)
+        with open(path, encoding="utf-8") as f:
+            fp = fingerprint_source(f.read(), rel)
+        d = digest(fp)
+        prev = modules.get(rel)
+        if prev is None:
+            modules[rel] = {"version": 1, "digest": d, "fingerprint": fp}
+            changed.append(rel)
+        elif prev["digest"] != d:
+            modules[rel] = {
+                "version": prev["version"] + 1,
+                "digest": d,
+                "fingerprint": fp,
+            }
+            changed.append(rel)
+    for rel in list(modules):
+        if rel not in config.CODEC_MODULES:
+            del modules[rel]
+            changed.append(rel)
+    out = {
+        "_comment": (
+            "Committed wire-format fingerprints (graftlint wire-drift "
+            "gate). Regenerate ONLY for intentional format changes: "
+            "python -m tools.graftlint --regen-fingerprints — the "
+            "version bump this writes is what review keys on, and the "
+            "matching golden (e.g. tests/goldens/*) must move in the "
+            "same PR."
+        ),
+        "modules": {k: modules[k] for k in sorted(modules)},
+    }
+    path = os.path.join(root, config.WIRE_LOCK_FILE)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return changed
+
+
+class WireDriftPass:
+    id = "wire-drift"
+
+    def scope(self, root: str) -> List[str]:
+        # Configured explicitly — codec modules, not a glob.
+        return [
+            rel
+            for rel in config.CODEC_MODULES
+            if os.path.exists(os.path.join(root, rel))
+        ]
+
+    def run(self, src: ModuleSource) -> Iterator[Tuple[Finding, ast.AST]]:
+        root = config.REPO_ROOT
+        # src.abspath is under some root; derive it so tests can point the
+        # pass at fixture trees.
+        if src.abspath.endswith(src.path.replace("/", os.sep)):
+            root = src.abspath[: -len(src.path) - 1] or root
+        lock = load_lock(root).get("modules", {})
+        fp = fingerprint_source(src.text, src.path)
+        entry = lock.get(src.path)
+        anchor = src.tree.body[0] if getattr(src.tree, "body", None) else src.tree
+        if entry is None:
+            yield (
+                src.finding(
+                    self.id,
+                    anchor,
+                    "codec module has no committed wire fingerprint — "
+                    "run `python -m tools.graftlint --regen-fingerprints` "
+                    "and commit api-report/wire_fingerprints.json",
+                ),
+                anchor,
+            )
+            return
+        if entry["digest"] != digest(fp):
+            diffs = _diff_keys(entry.get("fingerprint", {}), fp)
+            yield (
+                src.finding(
+                    self.id,
+                    anchor,
+                    "wire-format fingerprint drift ("
+                    + "; ".join(diffs or ["content"])
+                    + f") vs locked v{entry['version']} — if the format "
+                    "change is intentional, run `python -m tools.graftlint "
+                    "--regen-fingerprints` (bumps the version) and "
+                    "regenerate the matching golden in the same PR",
+                ),
+                anchor,
+            )
