@@ -396,7 +396,14 @@ def serving_benchmarks(on_tpu: bool) -> dict:
     try:
         import bench_configs as BC
         from fluidframework_tpu.service.pipeline import PipelineFluidService
+        from fluidframework_tpu.telemetry import metrics as _metrics
 
+        # Observability capture rides the PRIMARY serving lane: sampled
+        # frame traces (1-in-N, the alfred knob — untraced frames carry
+        # nothing) reduce into the registry's stage histogram, and one
+        # end-of-lane /metrics-style scrape pulls the per-shard device
+        # lanes in its contractual single readback.
+        _metrics.REGISTRY.reset()
         # k=8 keeps r4/r5 comparability; k=16 is the realistic
         # high-throughput client-turn batch (per-frame pipeline cost is
         # paid once per client batch, so frame size is a client choice,
@@ -408,6 +415,9 @@ def serving_benchmarks(on_tpu: bool) -> dict:
                 n_partitions=8,
                 device_max_batch=max(1 << 17, n_docs * k),
                 checkpoint_every=500,
+                # Sample the primary lane only: the k16 variant stays
+                # uninstrumented as the zero-tracing control.
+                messages_per_trace=(64 if on_tpu else 8) if not tag else 0,
             )
             doc_ids = [f"d{i}" for i in range(n_docs)]
             conns = BC._bulk_connect(svc, doc_ids)
@@ -422,6 +432,38 @@ def serving_benchmarks(on_tpu: bool) -> dict:
             out[f"pipeline_serving{tag}_flush_dispatch_s"] = rec[
                 "flush_dispatch_s"
             ]
+            if not tag:
+                # Settle in-flight boxcars so sampled traces complete
+                # (device_commit closes on the health-scan readback),
+                # then capture the continuous per-stage decomposition +
+                # the per-shard occupancy/err lanes — the r6 one-shot
+                # dispatch decomposition, generalized and driver-carried.
+                svc.flush_device()
+                out["serving_stage_spans_ms"] = (
+                    _metrics.stage_span_summary()
+                )
+                hist = _metrics.REGISTRY.get("serving_stage_ms")
+                out["serving_traces_completed"] = (
+                    hist.count(stage="total") if hist is not None else 0
+                )
+                tel = svc.device.publish_metrics()
+                cols = list(tel["cols"])
+                occ_i = cols.index("rows_in_use")
+                err_i = cols.index("err_docs")
+                out["device_shard_occupancy"] = {
+                    str(cap): [int(x) for x in arr[:, occ_i]]
+                    for cap, arr in sorted(tel["shards"].items())
+                }
+                out["device_shard_err_docs"] = {
+                    str(cap): [int(x) for x in arr[:, err_i]]
+                    for cap, arr in sorted(tel["shards"].items())
+                }
+                print(json.dumps({
+                    "metric": "serving_stage_spans_ms",
+                    "serving_stage_spans_ms": out["serving_stage_spans_ms"],
+                    "device_shard_occupancy": out["device_shard_occupancy"],
+                    "device_shard_err_docs": out["device_shard_err_docs"],
+                }))
             del svc, conns
     except Exception as e:  # noqa: BLE001 - artifact must say WHY
         out["serving_error_pipeline"] = repr(e)[:500]

@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import functools
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -97,6 +97,117 @@ def _pool_scan(state: SegmentState):
     synchronous pulls per flush were ~80% of pipeline flush wall on the
     tunneled backend)."""
     return jnp.stack([state.count, state.err])
+
+
+# Device telemetry lanes (telemetry/README.md): one jitted per-pool
+# reduction producing per-mesh-shard occupancy, err-bitmask counts BY BIT,
+# and the collab-window ring watermarks — consumed by /metrics scrapes
+# through DocFleet.telemetry_slice's SINGLE batched readback.
+TELEMETRY_ERR_BITS = 4  # ERR_CAPACITY / ERR_RANGE / ERR_CLIENT + spare
+TELEMETRY_COLS = (
+    "live_slots", "rows_in_use", "err_docs",
+    "err_bit0", "err_bit1", "err_bit2", "err_bit3",
+    "min_seq_floor", "cur_seq_head",
+)
+
+
+_SEQ_SENTINEL = 2**31 - 1  # dead rows must not lower the min_seq floor
+
+
+def _reduce_telemetry(live, count, err, min_seq, cur_seq, axis: int):
+    """THE column assembly every telemetry reduction shares — one body,
+    one ordering, so the layout cannot desynchronize from
+    :data:`TELEMETRY_COLS`. Inputs are 2-D blocks whose ``axis`` folds
+    (the other axis is the mesh-shard axis); ``live`` is the same-shape
+    bool occupancy mask (dead rows contribute nothing)."""
+    big = jnp.int32(_SEQ_SENTINEL)
+    count = jnp.where(live, count, 0)
+    err = jnp.where(live, err, 0)
+    min_seq = jnp.where(live, min_seq, big)
+    cur_seq = jnp.where(live, cur_seq, 0)
+    cols = [
+        live.astype(jnp.int32).sum(axis=axis),
+        count.sum(axis=axis),
+        (err != 0).astype(jnp.int32).sum(axis=axis),
+    ]
+    for b in range(TELEMETRY_ERR_BITS):
+        cols.append(((err >> b) & 1).sum(axis=axis))
+    floor = min_seq.min(axis=axis)
+    cols.append(jnp.where(floor == big, 0, floor))
+    cols.append(cur_seq.max(axis=axis))
+    return jnp.stack(cols, axis=1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _pool_telemetry(state: SegmentState, live, n_shards: int):
+    """[n_shards, len(TELEMETRY_COLS)] health reduction of one pool ON
+    DEVICE: the slot axis folds per mesh shard (the pool's sharded axis),
+    so the scrape reads aggregates, never lanes. ``live`` is the host
+    slot-occupancy mask uploaded with the dispatch (dummy slots must not
+    count as occupancy or contribute watermarks)."""
+    n = state.count.shape[0]
+    per = n // n_shards
+    shape = (n_shards, per)
+    return _reduce_telemetry(
+        live.reshape(shape),
+        state.count.reshape(shape),
+        state.err.reshape(shape),
+        state.min_seq.reshape(shape),
+        state.cur_seq.reshape(shape),
+        axis=1,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _scalars_telemetry(scalars, n_shards: int):
+    """The same [n_shards, len(TELEMETRY_COLS)] reduction over PACKED
+    scalars (the pallas ``pack_state`` layout's SC_* columns) — every row
+    live. Shared by the packed fleet service and the pallas DocShard."""
+    from fluidframework_tpu.ops.pallas_kernel import (
+        SC_COUNT,
+        SC_CUR_SEQ,
+        SC_ERR,
+        SC_MIN_SEQ,
+    )
+
+    per = scalars.shape[0] // n_shards
+    shape = (n_shards, per)
+    return _reduce_telemetry(
+        jnp.ones(shape, bool),
+        scalars[:, SC_COUNT].reshape(shape),
+        scalars[:, SC_ERR].reshape(shape),
+        scalars[:, SC_MIN_SEQ].reshape(shape),
+        scalars[:, SC_CUR_SEQ].reshape(shape),
+        axis=1,
+    )
+
+
+@jax.jit
+def _stacked_docs_telemetry(live, count, err, min_seq, cur_seq):
+    """[n_shards, len(TELEMETRY_COLS)] reduction over STACKED sharded-doc
+    scalars ([n_docs_padded, n_shards] each): a ShardedDoc is resident on
+    EVERY mesh shard, so the doc axis folds and the shard axis is
+    preserved — the 'sharded' pool row of one /metrics scrape. ``live``
+    is the per-doc mask ([n_docs_padded] bool): callers pad the doc axis
+    to pow2 so scrapes recompile O(log n), not per promotion."""
+    return _reduce_telemetry(
+        live[:, None] & jnp.ones(count.shape, bool),
+        count, err, min_seq, cur_seq, axis=0,
+    )
+
+
+def split_telemetry(host: np.ndarray, layout) -> Dict[Any, np.ndarray]:
+    """Slice one telemetry readback back into per-pool
+    [n_shards, len(TELEMETRY_COLS)] blocks (``layout`` =
+    [(pool key, n_shards), ...] in concatenation order; keys are pool
+    capacities (int) plus the backend's ``"sharded"`` row)."""
+    out: Dict[Any, np.ndarray] = {}
+    o = 0
+    ncol = len(TELEMETRY_COLS)
+    for cap, shards in layout:
+        out[cap] = host[o: o + shards * ncol].reshape(shards, ncol)
+        o += shards * ncol
+    return out
 
 
 @jax.jit
@@ -511,6 +622,37 @@ class DocFleet:
     def compact(self) -> None:
         for pool in self.pools.values():
             pool.state = pool._compact(pool.state)
+
+    def _telemetry_device(self):
+        """The device half of one scrape, NO readback: every pool's
+        jitted :func:`_pool_telemetry` reduction concatenated into one
+        flat device vector, plus the [(cap, n_shards), ...] layout to
+        split it with. Callers that need extra lanes in the SAME readback
+        (the backend's sharded-doc rows) concatenate onto this vector
+        before the one transfer."""
+        n_shards = self.mesh.devices.size if self.mesh is not None else 1
+        layout: List[Tuple[int, int]] = []
+        devs = []
+        for cap in sorted(self.pools):
+            pool = self.pools[cap]
+            shards = n_shards if pool.n_slots % n_shards == 0 else 1
+            layout.append((cap, shards))
+            live = jnp.asarray(pool.doc_of_slot >= 0)
+            devs.append(
+                _pool_telemetry(pool.state, live, shards).reshape(-1)
+            )
+        dev = jnp.concatenate(devs) if len(devs) > 1 else devs[0]
+        return dev, layout
+
+    def telemetry_slice(self) -> Dict[int, np.ndarray]:
+        """Per-pool, per-mesh-shard telemetry — cap -> [n_shards,
+        len(TELEMETRY_COLS)] — in EXACTLY ONE batched device→host
+        readback. This is the /metrics device contract
+        (telemetry/README.md) — per-lane or per-pool pulls would put
+        O(pools) synchronous round trips on every scrape."""
+        dev, layout = self._telemetry_device()
+        host = np.asarray(dev)  # graftlint: readback(the ONE batched telemetry readback per /metrics scrape — telemetry/README.md contract)
+        return split_telemetry(host, layout)
 
     def stats(self) -> dict:
         errs = 0

@@ -234,3 +234,24 @@ class DocShard:
             )
         else:
             self.state = batched_compact(self.state)
+
+    def telemetry_slice(self) -> np.ndarray:
+        """[n_devices, len(fleet.TELEMETRY_COLS)] per-mesh-shard health
+        (occupancy, err counts by bit, seq watermarks) in ONE batched
+        readback — the same jitted reductions the DocFleet pools use,
+        with every doc slot live (a DocShard has no free slots). The
+        pallas backend reduces straight off the packed scalar columns:
+        unpacking would materialize every [D, S] lane plane just to read
+        four scalars."""
+        from fluidframework_tpu.parallel.fleet import (
+            _pool_telemetry,
+            _scalars_telemetry,
+        )
+
+        n_shards = self.mesh.devices.size
+        if self.backend == "pallas":
+            dev = _scalars_telemetry(self._scalars, n_shards)
+        else:
+            n = int(self.state.count.shape[0])
+            dev = _pool_telemetry(self.state, jnp.ones(n, bool), n_shards)
+        return np.asarray(dev)  # graftlint: readback(one batched per-shard telemetry readback per scrape — telemetry/README.md contract)

@@ -29,14 +29,21 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Tuple
 
+import jax.numpy as jnp
 import numpy as np
 
 from fluidframework_tpu.ops.segment_state import (
     SEGMENT_LANES,
     materialize,
 )
-from fluidframework_tpu.parallel.fleet import DocFleet
+from fluidframework_tpu.parallel.fleet import (
+    TELEMETRY_COLS,
+    DocFleet,
+    _stacked_docs_telemetry,
+    split_telemetry,
+)
 from fluidframework_tpu.protocol.constants import F_ARG, F_SEQ, OP_WIDTH
+from fluidframework_tpu.telemetry import metrics, tracing
 from fluidframework_tpu.utils import pow2_at_least as _pow2_at_least
 
 ChannelKey = Tuple[str, str]  # (doc_id, channel address)
@@ -88,6 +95,11 @@ class DeviceFleetBackend:
         self._buffered_rows = 0
         self._flushes = 0
         self._scan_token = None  # in-flight async (count, err) pool scan
+        # Sampled-frame trace spine (telemetry/tracing.py): traces of
+        # frames enqueued since the last flush, then awaiting the health
+        # scan that covers their boxcar. Untraced frames never land here.
+        self._trace_pending: List[list] = []
+        self._trace_inflight: List[list] = []
         self._errored: set = set()  # fleet ids already reported
         self._unreported: List[ChannelKey] = []
         self.ops_applied = 0
@@ -209,6 +221,14 @@ class DeviceFleetBackend:
         self._buffered_rows += rows.shape[0]
         if self._buffered_rows >= self.max_batch:
             self.flush()
+
+    def track_trace(self, traces: list) -> None:
+        """Register a sampled frame's trace list: its ``device`` span ends
+        (and ``device_commit`` begins) when the next flush dispatches its
+        boxcar; ``device_commit`` ends when that boxcar's health scan is
+        consumed — the same one-boxcar-stale cadence the nack path rides,
+        stamped, never an extra readback."""
+        self._trace_pending.append(traces)
 
     # -- the boxcar step -------------------------------------------------------
 
@@ -362,6 +382,19 @@ class DeviceFleetBackend:
             if compact_now:
                 self.fleet.compact()
         self._buffered_rows = 0
+        if self._trace_pending:
+            # Sampled frames: the boxcar carrying them has been dispatched;
+            # their commit wait is the health scan begun above (or vacuous
+            # when nothing reached the fleet this flush).
+            for t in self._trace_pending:
+                tracing.stamp(t, tracing.STAGE_DEVICE, "end")
+                tracing.stamp(t, tracing.STAGE_DEVICE_COMMIT, "start")
+            if self._scan_token is None:
+                for t in self._trace_pending:
+                    tracing.stamp(t, tracing.STAGE_DEVICE_COMMIT, "end")
+            else:
+                self._trace_inflight.extend(self._trace_pending)
+            self._trace_pending = []
         self.last_flush_breakdown = {
             "staging_s": staging_s,
             "dispatch_s": dispatch_s,
@@ -380,6 +413,12 @@ class DeviceFleetBackend:
         """Run the health consequences of one (count, err) pool scan:
         tier promotion, sharded-overflow promotion, and sticky-err
         collection."""
+        if self._trace_inflight:
+            # The scan covering the traced boxcars has been read back:
+            # their device_commit span closes here.
+            for t in self._trace_inflight:
+                tracing.stamp(t, tracing.STAGE_DEVICE_COMMIT, "end")
+            self._trace_inflight = []
         counts = {cap: s[0] for cap, s in scans.items()}
         errs = {cap: s[1] for cap, s in scans.items()}
         self.fleet.check_and_migrate(counts)
@@ -502,6 +541,102 @@ class DeviceFleetBackend:
             for key in self._keys
             if self.ops_since_summary[key] + pending.get(key, 0) >= threshold
         ]
+
+    def _telemetry_start(self):
+        """The serving-thread half of one scrape: assemble the device-side
+        telemetry vector and snapshot the host-side totals. Reads LIVE
+        Python state (pool dicts, ``_sharded``), so it must run on the
+        thread that mutates them (the serving loop); the returned device
+        vector is a fresh concrete array safe to read back from any
+        thread."""
+        dev, layout = self.fleet._telemetry_device()
+        if self._sharded:
+            docs = [self._sharded[i] for i in sorted(self._sharded)]
+            # Pad the doc axis to pow2 (dead rows live-masked) so the
+            # jitted reduction recompiles O(log n) as promotions accrete,
+            # not once per new sharded doc — the fleet pools' own rule.
+            pad = _pow2_at_least(len(docs))
+            zero = jnp.zeros_like(docs[0].state.count)
+            live = jnp.asarray(np.arange(pad) < len(docs))
+
+            def lane(field):
+                rows = [getattr(d.state, field) for d in docs]
+                return jnp.stack(rows + [zero] * (pad - len(docs)))
+
+            sh = _stacked_docs_telemetry(
+                live, lane("count"), lane("err"),
+                lane("min_seq"), lane("cur_seq"),
+            )
+            layout = layout + [("sharded", sh.shape[0])]
+            dev = jnp.concatenate([dev, sh.reshape(-1)])
+        totals = {
+            "ops_applied": self.ops_applied,
+            "flushes": self._flushes,
+            "buffered_rows": self._buffered_rows,
+            "channels": len(self._keys),
+            "sharded_docs": len(self._sharded),
+        }
+        return dev, layout, totals
+
+    @staticmethod
+    def _telemetry_readback(dev) -> np.ndarray:
+        """The blocking device→host transfer of one scrape — ``dev`` is an
+        immutable concrete array, so async servers may run THIS half (and
+        only this half) off the serving thread."""
+        return np.asarray(dev)  # graftlint: readback(the ONE batched telemetry readback per /metrics scrape — telemetry/README.md contract)
+
+    @staticmethod
+    def _telemetry_finish(host: np.ndarray, layout, totals: dict) -> dict:
+        """Split one scrape's readback into the telemetry dict."""
+        return {
+            "shards": {
+                str(cap): arr
+                for cap, arr in split_telemetry(host, layout).items()
+            },
+            "cols": TELEMETRY_COLS,
+            **totals,
+        }
+
+    def telemetry(self) -> dict:
+        """One scrape's worth of device telemetry: the fleet's per-pool /
+        per-mesh-shard lanes PLUS a 'sharded' pool row covering every
+        sharded-overflow doc (the hottest, promoted documents must not go
+        dark), all in ONE batched readback — the /metrics contract — plus
+        the host-side commit totals that need no device round trip."""
+        dev, layout, totals = self._telemetry_start()
+        return self._telemetry_finish(
+            self._telemetry_readback(dev), layout, totals
+        )
+
+    def publish_metrics(self, registry=None, scrape: Optional[dict] = None) -> dict:
+        """Fold one :meth:`telemetry` scrape into per-shard registry
+        gauges (the /metrics handler calls this once per scrape; bench.py
+        merges the same dict into the driver artifact). ``scrape`` lets an
+        async server pass a scrape whose blocking readback it already ran
+        off-thread."""
+        reg = registry or metrics.REGISTRY
+        tel = scrape if scrape is not None else self.telemetry()
+        shard_g = reg.gauge(
+            "device_shard_telemetry",
+            "per-pool/per-mesh-shard device lanes (one readback/scrape)",
+            labelnames=("pool", "shard", "col"),
+        )
+        for cap, arr in tel["shards"].items():
+            for shard in range(arr.shape[0]):
+                for i, col in enumerate(tel["cols"]):
+                    shard_g.set(
+                        int(arr[shard, i]),
+                        pool=str(cap), shard=str(shard), col=col,
+                    )
+        totals = reg.gauge(
+            "device_backend_totals",
+            "host-side device-backend commit totals",
+            labelnames=("key",),
+        )
+        for key in ("ops_applied", "flushes", "buffered_rows", "channels",
+                    "sharded_docs"):
+            totals.set(tel[key], key=key)
+        return tel
 
     def stats(self) -> dict:
         s = self.fleet.stats()

@@ -32,6 +32,7 @@ from fluidframework_tpu.protocol.types import MessageType
 from fluidframework_tpu.runtime.op_lifecycle import RemoteMessageProcessor
 from fluidframework_tpu.service.device_backend import DeviceFleetBackend
 from fluidframework_tpu.service.lambdas import PartitionLambda
+from fluidframework_tpu.telemetry import tracing
 
 
 class TpuDeliLambda(PartitionLambda):
@@ -47,6 +48,13 @@ class TpuDeliLambda(PartitionLambda):
             # Batched binary wire (protocol/opframe.py): the rows ARE
             # kernel rows, already stamped — no per-op decode at all.
             frame = value["frame"]
+            traces = value.get("traces")
+            if traces is not None:
+                # Sampled frame: the device span opens at enqueue; the
+                # backend closes it (and the commit span) at flush /
+                # scan-consume time.
+                tracing.stamp(traces, tracing.STAGE_DEVICE, "start")
+                self.backend.track_trace(traces)
             self.backend.enqueue_frame(self.doc_id, frame)
             return []
         if value["t"] != "seq":

@@ -118,6 +118,10 @@ from fluidframework_tpu.ops.segment_state import (
     make_batched_state,
     materialize,
 )
+from fluidframework_tpu.parallel.fleet import (
+    TELEMETRY_COLS,
+    _scalars_telemetry,
+)
 from fluidframework_tpu.protocol.constants import (
     F_CLIENT,
     F_LSEQ,
@@ -225,6 +229,7 @@ def _expand_wire(buf, widths, d, k):
 _scan_slim = jax.jit(
     lambda s: jnp.stack([s[:, SC_COUNT], s[:, SC_CUR_SEQ]], axis=1)
 )
+
 
 # One document's packed state sliced ON DEVICE: a [L, S] table block plus
 # one scalar row cross the link, not one transfer per lane (the
@@ -402,6 +407,19 @@ class TpuFleetService:
     def device_errors(self) -> np.ndarray:
         """Sticky per-doc kernel err lane ([D] readback — the barrier)."""
         return np.asarray(self.scalars[:, SC_ERR])  # graftlint: readback(the documented explicit error barrier)
+
+    def telemetry_slice(self, n_shards: int = 1) -> np.ndarray:
+        """Per-shard occupancy/err-bit/watermark lanes in ONE batched
+        readback per scrape (the /metrics device contract): the jitted
+        reduction folds the whole packed fleet to
+        [n_shards, len(TELEMETRY_COLS)] on device — never a per-lane or
+        per-doc pull. A doc count that doesn't divide over ``n_shards``
+        degrades to one aggregate row (the DocFleet pool rule)."""
+        if int(self.scalars.shape[0]) % n_shards != 0:
+            n_shards = 1
+        dev = _scalars_telemetry(self.scalars, n_shards)
+        assert dev.shape[1] == len(TELEMETRY_COLS)
+        return np.asarray(dev)  # graftlint: readback(the ONE batched telemetry readback per /metrics scrape — telemetry/README.md contract)
 
     def doc_state(self, doc: int) -> SegmentState:
         """One document's merge state read back to host (two transfers:

@@ -36,7 +36,7 @@ from fluidframework_tpu.protocol.types import (
     SequencedDocumentMessage,
 )
 from fluidframework_tpu.service.queue import PartitionedLog
-from fluidframework_tpu.telemetry import LumberEventName, Lumberjack
+from fluidframework_tpu.telemetry import LumberEventName, Lumberjack, metrics, tracing
 from fluidframework_tpu.service.sequencer import (
     DocumentSequencer,
     SequencerCheckpoint,
@@ -445,10 +445,19 @@ class DeliDocLambda(PartitionLambda):
 
         client = value["client"]
         frame = value["frame"]
+        # Sampled frame (trace list rides the record envelope): the
+        # alfred span closes at pump dequeue, the deli span brackets the
+        # vectorized ticket. Untraced frames skip every stamp.
+        traces = value.get("traces")
+        if traces is not None:
+            tracing.stamp(traces, tracing.STAGE_ALFRED, "end")
+            tracing.stamp(traces, tracing.STAGE_DELI, "start")
         fr = frame.rows
         res = self.sequencer.ticket_frame(
             client, frame.csn0, frame.n, fr[:, F_REF]
         )
+        if traces is not None:
+            tracing.stamp(traces, tracing.STAGE_DELI, "end")
         if res is None:
             return []
         if isinstance(res, NackMessage):
@@ -472,9 +481,13 @@ class DeliDocLambda(PartitionLambda):
             frame.address, client, frame.csn0 + res.drop, rows, texts,
             res.timestamp,
         )
-        out: List[Tuple[str, str, Any]] = [
-            (DELTAS_TOPIC, key, {"t": "seqframe", "frame": sf})
-        ]
+        seq_rec: Dict[str, Any] = {"t": "seqframe", "frame": sf}
+        if traces is not None:
+            # The SAME list object rides the sequenced record: every
+            # downstream consumer group (scriptorium, broadcaster, the
+            # device stage) stamps into it in-proc.
+            seq_rec["traces"] = traces
+        out: List[Tuple[str, str, Any]] = [(DELTAS_TOPIC, key, seq_rec)]
         if res.trailing_nack is not None:
             out.append((DELTAS_TOPIC, key, {"t": "nack", "client": client,
                                             "nack": res.trailing_nack}))
@@ -651,7 +664,12 @@ class ScriptoriumLambda(PartitionLambda):
         if value["t"] == "seq":
             self._doc(key).add_msg(value["msg"])
         elif value["t"] == "seqframe":
+            traces = value.get("traces")
+            if traces is not None:
+                tracing.stamp(traces, tracing.STAGE_SCRIPTORIUM, "start")
             self._doc(key).add_frame(value["frame"])
+            if traces is not None:
+                tracing.stamp(traces, tracing.STAGE_SCRIPTORIUM, "end")
         return []
 
     def handler_batch(self, recs) -> List[Tuple[str, str, Any]]:
@@ -660,10 +678,17 @@ class ScriptoriumLambda(PartitionLambda):
             value = rec.value
             t = value.get("t")
             if t == "seqframe":
+                traces = value.get("traces")
+                if traces is not None:
+                    tracing.stamp(
+                        traces, tracing.STAGE_SCRIPTORIUM, "start"
+                    )
                 log = store.get(rec.key)
                 if log is None:
                     log = store[rec.key] = DocOpLog()
                 log.add_frame(value["frame"])
+                if traces is not None:
+                    tracing.stamp(traces, tracing.STAGE_SCRIPTORIUM, "end")
             elif t == "seq":
                 self._doc(rec.key).add_msg(value["msg"])
         return []
@@ -682,13 +707,37 @@ class BroadcasterLambda(PartitionLambda):
 
     wants = frozenset({"seq", "seqframe", "nack"})
 
-    def __init__(self, rooms: Dict[str, list]):
+    def __init__(self, rooms: Dict[str, list], observe_traces: bool = False):
         self.rooms = rooms
+        # Per-op span reduction is OPT-IN, on only when the SERVICE
+        # samples traces (traces is a client-controlled wire field; with
+        # sampling off nothing the server didn't ask for may reach the
+        # registry — so client-trust must never be the default).
+        self.observe_traces = observe_traces
 
     def handler(self, key: str, value: dict) -> List[Tuple[str, str, Any]]:
         conns = self.rooms.get(key, [])
         if value["t"] == "seq":
             msg = value["msg"]
+            if (
+                self.observe_traces
+                and msg.traces
+                and tracing.has_stamp(
+                    msg.traces, tracing.STAGE_ALFRED, "start"
+                )
+                and not tracing.has_stamp(
+                    msg.traces, tracing.STAGE_ALFRED, "end"
+                )
+            ):
+                # Sampled per-op path: broadcast is where the op leaves
+                # the service, so the front door's span closes HERE — the
+                # missing ``alfred end`` that kept spans() from ever
+                # producing ``alfred_ms`` — and the completed trace
+                # reduces into the registry. The not-already-ended guard
+                # keeps a deli crash/replay (same sequenced op re-emitted
+                # downstream) from double-observing the span.
+                tracing.stamp(msg.traces, tracing.STAGE_ALFRED, "end")
+                metrics.observe_stage_spans(tracing.spans(msg.traces))
             for conn in conns:
                 if msg.sequence_number > conn.delivered_seq:
                     conn.inbox.append(msg)
@@ -698,6 +747,9 @@ class BroadcasterLambda(PartitionLambda):
             # the socket drain) expands. A partially-delivered frame
             # (replay straddling the watermark) expands the tail only.
             frame = value["frame"]
+            traces = value.get("traces")
+            if traces is not None:
+                tracing.stamp(traces, tracing.STAGE_BROADCAST, "start")
             for conn in conns:
                 if frame.last_seq <= conn.delivered_seq:
                     continue
@@ -708,6 +760,8 @@ class BroadcasterLambda(PartitionLambda):
                         frame.messages(conn.delivered_seq - frame.first_seq + 1)
                     )
                 conn.delivered_seq = frame.last_seq
+            if traces is not None:
+                tracing.stamp(traces, tracing.STAGE_BROADCAST, "end")
         elif value["t"] == "nack":
             for conn in conns:
                 if value.get("client") == conn.client_id or (

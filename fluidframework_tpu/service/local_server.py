@@ -22,7 +22,7 @@ from fluidframework_tpu.protocol.types import (
 )
 from fluidframework_tpu.service.sequencer import DocumentSequencer
 from fluidframework_tpu.service.summary_store import SummaryStore
-from fluidframework_tpu.telemetry import tracing
+from fluidframework_tpu.telemetry import metrics, tracing
 
 
 @dataclass
@@ -239,6 +239,20 @@ class LocalFluidService:
             conn.signals.append(sig)
 
     def _broadcast(self, doc: _DocState, msg: SequencedDocumentMessage) -> None:
+        if (
+            self.trace_sampler is not None
+            and msg.traces
+            and tracing.has_stamp(msg.traces, tracing.STAGE_ALFRED, "start")
+            and not tracing.has_stamp(msg.traces, tracing.STAGE_ALFRED, "end")
+        ):
+            # Close the front door's span where the op leaves the service
+            # (the reference's alfred end stamp): without this, spans()
+            # could never produce ``alfred_ms`` on the per-op path. The
+            # sampler gate keeps client-supplied wire traces out of the
+            # registry when the service isn't sampling; the already-ended
+            # guard keeps replays from double-observing.
+            tracing.stamp(msg.traces, tracing.STAGE_ALFRED, "end")
+            metrics.observe_stage_spans(tracing.spans(msg.traces))
         doc.op_log.append(msg)
         for conn in doc.connections.values():
             conn.inbox.append(msg)

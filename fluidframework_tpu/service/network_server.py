@@ -39,6 +39,7 @@ from urllib.parse import parse_qs, urlparse
 from fluidframework_tpu.service import wsproto
 from fluidframework_tpu.service.codec import from_jsonable, to_jsonable
 from fluidframework_tpu.service.local_server import LocalFluidService
+from fluidframework_tpu.telemetry import metrics
 
 
 class TenantManager:
@@ -217,6 +218,16 @@ class FluidNetworkServer:
                 + payload
             )
 
+        if method == "GET" and parts == ["metrics"]:
+            # Prometheus exposition (unauthenticated, like the health
+            # surface): refresh the device gauges with the contractual
+            # ONE batched readback, then render the process registry.
+            reply(
+                200, await self._metrics_payload(),
+                ctype="text/plain; version=0.0.4; charset=utf-8",
+            )
+            await writer.drain()
+            return
         # Delta/document routes are doc-scoped; blob routes use a
         # storage-scope token (minted for the empty doc id), since handles
         # aren't per-document.
@@ -317,6 +328,26 @@ class FluidNetworkServer:
         else:
             reply(404, b'{"error": "not found"}')
         await writer.drain()
+
+    async def _metrics_payload(self) -> bytes:
+        """One /metrics scrape: refresh the wrapped service's device
+        gauges — exactly ONE batched telemetry readback — then render the
+        process registry. The scrape's Python-state halves (assembly,
+        gauge fold) run ON the event loop, serialized with the serving
+        traffic that mutates fleet state; only the blocking device→host
+        transfer runs off-loop, so a scrape neither races a promotion nor
+        stalls websocket traffic for a device round trip. A service
+        without a device stage just renders."""
+        backend = getattr(self.service, "device", None)
+        if backend is not None:
+            dev, layout, totals = backend._telemetry_start()
+            host = await asyncio.get_running_loop().run_in_executor(
+                None, backend._telemetry_readback, dev
+            )
+            backend.publish_metrics(
+                scrape=backend._telemetry_finish(host, layout, totals)
+            )
+        return metrics.REGISTRY.render().encode()
 
     def _authorized(self, params: dict, doc_id: str) -> bool:
         if self.tenants is None:

@@ -146,6 +146,12 @@ class PipelineFluidService:
         self.trace_sampler = (
             tracing.TraceSampler(messages_per_trace) if messages_per_trace else None
         )
+        # Frame-spine ledger: sampled frames' trace lists live here until
+        # every stage (broadcast + device commit when a device stage runs)
+        # has stamped; pump() reaps complete ones into the metrics
+        # registry. Untraced frames never touch it (zero steady-state
+        # cost — the sampler gate is the only per-frame branch).
+        self.trace_book = tracing.TraceBook(expect_device=device_backend)
         self.ops_store: Dict[str, DocOpLog] = {}
         self.rooms: Dict[str, list] = {}
         self._token_counter = itertools.count(1)
@@ -158,7 +164,9 @@ class PipelineFluidService:
         )
         self._broadcaster = PartitionRunner(
             self.log, DELTAS_TOPIC, "broadcaster",
-            lambda p, s: BroadcasterLambda(self.rooms),
+            lambda p, s: BroadcasterLambda(
+                self.rooms, observe_traces=self.trace_sampler is not None
+            ),
             self.checkpoints, checkpoint_every,
         )
         self._signals = PartitionRunner(
@@ -367,6 +375,10 @@ class PipelineFluidService:
                     # again; the nack must not depend on future traffic).
                     self.device.collect_now()
                     self._nack_device_errors()
+                if self.trace_sampler is not None:
+                    # Sampled frames whose last stage stamped this sweep
+                    # reduce into the registry now (tracing.spans).
+                    self.trace_book.reap()
                 return total
 
     # -- the device serving surface -------------------------------------------
@@ -384,6 +396,8 @@ class PipelineFluidService:
         # are deliberately one boxcar stale).
         self.device.collect_now()
         self._nack_device_errors()
+        if self.trace_sampler is not None:
+            self.trace_book.reap()
 
     def _nack_device_errors(self) -> None:
         for doc_id, address in self.device.take_errors():
@@ -485,11 +499,17 @@ class PipelineFluidService:
 
     def submit_frame(self, doc_id: str, client_id: int, frame) -> None:
         """Front-door ingest for the batched binary wire: one raw record
-        per frame; deli tickets it vectorized (sequencer.ticket_frame)."""
-        self.log.send(
-            RAW_TOPIC, doc_id,
-            {"t": "opframe", "client": client_id, "frame": frame},
-        )
+        per frame; deli tickets it vectorized (sequencer.ticket_frame).
+        Sampled frames (alfred's 1-in-N gate, same knob as the per-op
+        wire) carry a trace list on the RECORD envelope — the binary
+        frame wire itself never changes — stamped at every stage
+        boundary downstream."""
+        rec = {"t": "opframe", "client": client_id, "frame": frame}
+        if self.trace_sampler is not None and self.trace_sampler.should_trace():
+            traces = self.trace_book.open()
+            tracing.stamp(traces, tracing.STAGE_ALFRED, "start")
+            rec["traces"] = traces
+        self.log.send(RAW_TOPIC, doc_id, rec)
         self.pump()
 
     def submit_frames_bulk(self, items, pump: bool = True) -> None:
@@ -500,10 +520,22 @@ class PipelineFluidService:
         was a measurable share of the serving path (the reference batches
         the same way: socket submits boxcar into one Kafka produce,
         ``pendingBoxcar.ts``)."""
-        entries = [
-            (doc_id, {"t": "opframe", "client": client_id, "frame": frame})
-            for doc_id, client_id, frame in items
-        ]
+        sampler = self.trace_sampler
+        if sampler is None:
+            entries = [
+                (doc_id, {"t": "opframe", "client": client_id,
+                          "frame": frame})
+                for doc_id, client_id, frame in items
+            ]
+        else:
+            entries = []
+            for doc_id, client_id, frame in items:
+                rec = {"t": "opframe", "client": client_id, "frame": frame}
+                if sampler.should_trace():
+                    traces = self.trace_book.open()
+                    tracing.stamp(traces, tracing.STAGE_ALFRED, "start")
+                    rec["traces"] = traces
+                entries.append((doc_id, rec))
         send_batch = getattr(self.log, "send_batch", None)
         if send_batch is not None:
             send_batch(RAW_TOPIC, entries)

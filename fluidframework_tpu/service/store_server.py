@@ -41,6 +41,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from fluidframework_tpu.service.codec import decode_value, encode_value
 from fluidframework_tpu.service.queue import LogRecord, partition_of
+from fluidframework_tpu.telemetry import metrics
 from fluidframework_tpu.utils.lru import LruCache
 from fluidframework_tpu.service.summary_store import SummaryStore
 
@@ -54,13 +55,17 @@ def _send_msg(sock: socket.socket, head: dict, body: bytes = b"") -> None:
     sock.sendall(json.dumps(head).encode() + b"\n" + body)
 
 
+def _parse_msg(line: bytes, f) -> Tuple[dict, bytes]:
+    head = json.loads(line)
+    body = f.read(head.get("blen", 0)) if head.get("blen") else b""
+    return head, body
+
+
 def _recv_msg(f) -> Tuple[dict, bytes]:
     line = f.readline()
     if not line:
         raise ConnectionError("peer closed")
-    head = json.loads(line)
-    body = f.read(head.get("blen", 0)) if head.get("blen") else b""
-    return head, body
+    return _parse_msg(line, f)
 
 
 # ---------------------------------------------------------------------------
@@ -72,7 +77,24 @@ class _Handler(socketserver.StreamRequestHandler):
         srv: "StoreServer" = self.server.store_node  # type: ignore
         while True:
             try:
-                head, body = _recv_msg(self.rfile)
+                line = self.rfile.readline()
+            except OSError:
+                return
+            if not line:
+                return
+            if line.split(b" ")[:2] == [b"GET", b"/metrics"]:
+                # Prometheus scrape on the store port: plain HTTP on the
+                # same socket (the store node has no separate admin
+                # listener) — drain the request head, render, close.
+                try:
+                    while self.rfile.readline() not in (b"\r\n", b"\n", b""):
+                        pass
+                    self.connection.sendall(srv.metrics_payload())
+                except OSError:
+                    pass
+                return
+            try:
+                head, body = _parse_msg(line, self.rfile)
             except (ConnectionError, ValueError, OSError):
                 return
             try:
@@ -149,6 +171,19 @@ class StoreServer:
     # -- request dispatch ------------------------------------------------------
 
     def dispatch(self, head: dict, body: bytes) -> Tuple[dict, bytes]:
+        resp, rbody = self._dispatch(head, body)
+        # Count AFTER dispatch so an unrecognized client-supplied op
+        # string collapses to one label — the socket is unauthenticated,
+        # and a counter label set is permanent registry memory.
+        known = not str(resp.get("error", "")).startswith("unknown op")
+        metrics.REGISTRY.counter(
+            "store_requests_total",
+            "store-node requests by operation",
+            labelnames=("op",),
+        ).inc(op=head["op"] if known else "unknown")
+        return resp, rbody
+
+    def _dispatch(self, head: dict, body: bytes) -> Tuple[dict, bytes]:
         op = head["op"]
         with self._lock:
             if op == "blob.put":
@@ -239,6 +274,19 @@ class StoreServer:
             if op == "meta":
                 return {"ok": True, "n_partitions": self.n_partitions}, b""
         return {"ok": False, "error": f"unknown op {op}"}, b""
+
+    def metrics_payload(self) -> bytes:
+        """One complete HTTP response carrying the process registry in
+        Prometheus text format — what a ``GET /metrics`` on the store
+        port receives (the store node is device-free, so a scrape here
+        never touches an accelerator)."""
+        body = metrics.REGISTRY.render().encode()
+        return (
+            "HTTP/1.1 200 OK\r\n"
+            "Content-Type: text/plain; version=0.0.4; charset=utf-8\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            "Connection: close\r\n\r\n"
+        ).encode() + body
 
     # -- lifecycle -------------------------------------------------------------
 

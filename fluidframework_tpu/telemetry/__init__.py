@@ -25,9 +25,22 @@ from fluidframework_tpu.telemetry.lumberjack import (
     LumberEventName,
     Lumberjack,
 )
-from fluidframework_tpu.telemetry import tracing
+from fluidframework_tpu.telemetry import metrics, tracing
+from fluidframework_tpu.telemetry.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+)
 
 __all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "REGISTRY",
+    "metrics",
     "ChildLogger",
     "CollectingEngine",
     "CollectingLogger",

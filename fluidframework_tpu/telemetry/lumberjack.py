@@ -88,6 +88,22 @@ class Lumber:
             record["schemaValidationFailed"] = missing
         for engine in self._engines:
             engine(record)
+        # Every completion also feeds the unified registry (one counter by
+        # outcome + one duration histogram per event) so /metrics carries
+        # the control-plane aggregate without a collecting engine.
+        from fluidframework_tpu.telemetry import metrics
+
+        reg = metrics.REGISTRY
+        reg.counter(
+            "lumber_events_total",
+            "completed Lumber metrics by event and outcome",
+            labelnames=("event", "outcome"),
+        ).inc(event=self.event_name, outcome="ok" if success else "error")
+        reg.histogram(
+            "lumber_duration_ms",
+            "Lumber metric durations (ms)",
+            labelnames=("event",),
+        ).observe(record["durationInMs"], event=self.event_name)
 
     def success(self, message: str = "") -> None:
         self._emit(True, message)

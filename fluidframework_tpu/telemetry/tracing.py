@@ -17,6 +17,29 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional
 
+# Frame-spine stage vocabulary (telemetry/README.md): each stage stamps
+# start/end around its own work, so ``spans()`` yields ``{stage}_ms``:
+#   alfred        front-door receipt -> pump dequeue (raw-log queue wait)
+#   deli          the vectorized ticket_frame call
+#   scriptorium   the durable DocOpLog append
+#   device        device-stage enqueue -> boxcar dispatch issued
+#   device_commit dispatch issued -> health-scan readback consumed
+#   broadcast     room fan-out to connected sessions
+STAGE_ALFRED = "alfred"
+STAGE_DELI = "deli"
+STAGE_SCRIPTORIUM = "scriptorium"
+STAGE_DEVICE = "device"
+STAGE_DEVICE_COMMIT = "device_commit"
+STAGE_BROADCAST = "broadcast"
+FRAME_STAGES = (
+    STAGE_ALFRED,
+    STAGE_DELI,
+    STAGE_SCRIPTORIUM,
+    STAGE_DEVICE,
+    STAGE_DEVICE_COMMIT,
+    STAGE_BROADCAST,
+)
+
 
 def stamp(traces: List[dict], service: str, action: str, timestamp: Optional[float] = None) -> None:
     """Append one trace entry in place (reference ``ITrace``)."""
@@ -63,3 +86,86 @@ def spans(traces: List[dict]) -> Dict[str, float]:
     ts = [t["timestamp"] for t in traces]
     out["total_ms"] = (max(ts) - min(ts)) * 1e3
     return out
+
+
+def has_stamp(traces: List[dict], service: str, action: str) -> bool:
+    return any(
+        t["service"] == service and t["action"] == action for t in traces
+    )
+
+
+class TraceBook:
+    """Ledger of live sampled-frame traces for one serving pipeline.
+
+    The front door ``open()``s a trace list per sampled frame; every
+    stage stamps the SAME list object (the in-proc log shares record
+    values across consumer groups, so one mutation is visible to all —
+    stages on a remote log see a decoded copy and simply stop stamping,
+    which degrades to a partial trace, never a wrong one). ``reap()``
+    reduces each COMPLETE trace — broadcast stamped, and when a device
+    stage exists its commit stamped too (the device boxcar flushes at
+    pump quiescence, temporally AFTER broadcast) — into per-stage span
+    observations on the metrics registry, keeping a bounded tail of
+    span dicts for benches/tests. Untraced frames never touch this
+    class: steady-state cost stays zero.
+    """
+
+    def __init__(
+        self,
+        expect_device: bool = False,
+        max_live: int = 256,
+        keep_completed: int = 64,
+        registry=None,
+    ):
+        self.expect_device = expect_device
+        self.max_live = max_live
+        self.keep_completed = keep_completed
+        self._registry = registry
+        self._live: List[List[dict]] = []
+        self.completed: List[Dict[str, float]] = []
+        self.dropped = 0  # traces evicted incomplete (nacked/dup frames)
+
+    def open(self) -> List[dict]:
+        traces: List[dict] = []
+        self._live.append(traces)
+        if len(self._live) > self.max_live:
+            # Incomplete stragglers (nacked frames, replay-duplicate
+            # drops) must not pin memory forever: evict oldest-first.
+            self.dropped += len(self._live) - self.max_live
+            del self._live[: len(self._live) - self.max_live]
+        return traces
+
+    def _complete(self, traces: List[dict]) -> bool:
+        if not has_stamp(traces, STAGE_BROADCAST, "end"):
+            return False
+        if self.expect_device and has_stamp(traces, STAGE_DEVICE, "start"):
+            # The frame reached the device stage: its decomposition is
+            # complete only once the commit readback landed.
+            return has_stamp(traces, STAGE_DEVICE_COMMIT, "end")
+        return True
+
+    def reap(self) -> int:
+        """Reduce every complete live trace into the registry; returns
+        how many completed this call."""
+        if not self._live:
+            return 0
+        from fluidframework_tpu.telemetry import metrics
+
+        done: List[List[dict]] = []
+        kept: List[List[dict]] = []
+        for t in self._live:
+            (done if self._complete(t) else kept).append(t)
+        if not done:
+            return 0
+        self._live = kept
+        for traces in done:
+            sp = spans(traces)
+            metrics.observe_stage_spans(sp, self._registry)
+            self.completed.append(sp)
+        if len(self.completed) > self.keep_completed:
+            del self.completed[: len(self.completed) - self.keep_completed]
+        return len(done)
+
+    @property
+    def live(self) -> int:
+        return len(self._live)

@@ -296,16 +296,24 @@ class EditManager:
                 reason = err_reason
         for c in commits:
             self.add_sequenced(c)
-            self._count_host(reason)
+        self._count_host(reason, len(commits))
         self.advance_min_seq(min_seq)
 
-    def _count_host(self, reason: str) -> None:
-        """One host-path commit, attributed to its fallback cause."""
-        self.host_commits += 1
+    def _count_host(self, reason: str, n: int = 1) -> None:
+        """``n`` host-path commits, attributed to their fallback cause —
+        and mirrored into the unified registry (one inc per batch) so the
+        ROADMAP's fallback-bucket burn-down is visible on /metrics, not
+        only in tests."""
+        from fluidframework_tpu.telemetry import metrics
+
+        if not n:
+            return
+        self.host_commits += n
         key = reason or "kernel"
         self.host_fallback_reason[key] = (
-            self.host_fallback_reason.get(key, 0) + 1
+            self.host_fallback_reason.get(key, 0) + n
         )
+        metrics.tree_ingest_counter().inc(n, path="host", reason=key)
 
     @staticmethod
     def _err_reason(err: int) -> str:
@@ -685,6 +693,11 @@ class EditManager:
         self.branches.clear()
         self.device_commits += len(commits)
         self.device_batches += 1
+        from fluidframework_tpu.telemetry import metrics
+
+        metrics.tree_ingest_counter().inc(
+            len(commits), path="device", reason=""
+        )
         return True, ""
 
     def _device_ingest(self, commits: List[Commit], lr: int) -> Tuple[bool, str]:
@@ -1057,7 +1070,7 @@ def batch_ingest(
         rest = commits[prefix:] if device_ok else commits
         for c in rest:
             em.add_sequenced(c)
-            em._count_host(reason)
-            stats["host_commits"] += 1
+        em._count_host(reason, len(rest))
+        stats["host_commits"] += len(rest)
         em.advance_min_seq(min_seq)
     return stats

@@ -76,7 +76,11 @@ class SharedTree(SharedObject):
     def ingest_stats(self) -> dict:
         """Counters proving which path integrated commits, with the host
         tail broken down by fallback cause (r7: with moves device-native,
-        the remaining host share must be attributable, not a lump)."""
+        the remaining host share must be attributable, not a lump). The
+        same tallies feed the unified registry as the labeled
+        ``tree_ingest_commits_total{path,reason}`` counter at the point
+        of counting (EditManager), so the burn-down is visible on
+        ``GET /metrics``, not only in test assertions."""
         return {
             "device_commits": self._em.device_commits,
             "device_batches": self._em.device_batches,

@@ -78,6 +78,45 @@ def test_r6_exempt_from_tree_moves_fraction(tmp_path):
     assert cba.check(str(tmp_path)) == 0
 
 
+def test_r9_requires_observability_keys(tmp_path):
+    """An r9+ artifact must carry the sampled-frame stage decomposition
+    AND the per-shard occupancy lanes from the single-readback telemetry
+    scrape — the prior headline keys alone are incomplete."""
+    cba = _tool()
+    prior = {
+        "pipeline_serving_ops_per_sec": 2,
+        "deli_scribe_e2e_ops_per_sec": 3,
+        "fleet_mesh_ops_per_sec": 4,
+        "tree_moves_device_fraction": 0.97,
+    }
+    _write(tmp_path, "BENCH_r09.json", [json.dumps(prior)])
+    assert cba.check(str(tmp_path)) == 1
+    # One of the pair is not enough.
+    _write(tmp_path, "BENCH_r09.json", [json.dumps(dict(
+        prior, serving_stage_spans_ms={"deli": 0.2, "total": 4.5},
+    ))])
+    assert cba.check(str(tmp_path)) == 1
+    _write(tmp_path, "BENCH_r09.json", [json.dumps(dict(
+        prior,
+        serving_stage_spans_ms={"deli": 0.2, "total": 4.5},
+        device_shard_occupancy={"128": [5, 5, 5, 5]},
+    ))])
+    assert cba.check(str(tmp_path)) == 0
+
+
+def test_r8_exempt_from_observability_keys(tmp_path):
+    """Per-key since-round gating: an r8 artifact predates the
+    observability pair and passes with the four prior keys."""
+    cba = _tool()
+    _write(tmp_path, "BENCH_r08.json", [json.dumps({
+        "pipeline_serving_ops_per_sec": 2,
+        "deli_scribe_e2e_ops_per_sec": 3,
+        "fleet_mesh_ops_per_sec": 4,
+        "tree_moves_device_fraction": 0.97,
+    })])
+    assert cba.check(str(tmp_path)) == 0
+
+
 def test_newest_round_governs(tmp_path):
     cba = _tool()
     _write(tmp_path, "BENCH_r05.json", ['{"metric": "old"}'])

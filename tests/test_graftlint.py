@@ -141,6 +141,38 @@ def test_host_sync_pragma_without_reason_does_not_suppress(tmp_path):
     assert "no reason" in pragma_errors[0].message
 
 
+def test_host_sync_telemetry_slice_readback_pragma(tmp_path):
+    """The r9 telemetry-lane shape: a jitted per-shard reduction whose
+    single batched result is read back once per /metrics scrape. The
+    np.asarray IS a device→host transfer — flagged bare, suppressed by
+    the reasoned one-readback-per-scrape pragma."""
+    _, HostSync, *_ = _tools()
+    snippet = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def _pool_telemetry(state, n_shards):
+        return state.count.reshape(n_shards, -1).sum(axis=1)
+
+    def telemetry_slice(pool, n_shards):
+        dev = _pool_telemetry(pool.state, n_shards)
+        return np.asarray(dev){pragma}
+    """
+    bare = _run_pass(HostSync, snippet.format(pragma=""), tmp_path)
+    assert len(bare) == 1 and "device→host" in bare[0].message
+    annotated = _run_pass(
+        HostSync,
+        snippet.format(
+            pragma="  # graftlint: readback(the ONE batched telemetry"
+            " readback per /metrics scrape)"
+        ),
+        tmp_path,
+    )
+    assert annotated == []
+
+
 # -- recompile-hazard ----------------------------------------------------------
 
 

@@ -25,12 +25,17 @@ from typing import List, Tuple
 # The headline keys bench.py merges into the driver line, each with the
 # first round whose artifact must carry it (earlier artifacts are the
 # historical record, not subject to the gate). The serving trio landed in
-# r6; the device-native move-marks fraction (config 3c-moves) in r7.
+# r6; the device-native move-marks fraction (config 3c-moves) in r7; the
+# observability pair — the sampled-frame per-stage latency decomposition
+# and the per-shard device occupancy lanes from the single-readback
+# telemetry scrape — in r9.
 REQUIRED = (
     ("pipeline_serving_ops_per_sec", 6),
     ("deli_scribe_e2e_ops_per_sec", 6),
     ("fleet_mesh_ops_per_sec", 6),
     ("tree_moves_device_fraction", 7),
+    ("serving_stage_spans_ms", 9),
+    ("device_shard_occupancy", 9),
 )
 # Artifacts up to round 5 predate every gated metric.
 BASELINE_ROUND = 5
