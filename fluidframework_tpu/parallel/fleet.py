@@ -42,6 +42,7 @@ from fluidframework_tpu.ops.segment_state import (
     SEGMENT_LANES,
     SegmentState,
 )
+from fluidframework_tpu.parallel import aot
 from fluidframework_tpu.protocol.constants import (
     ERR_CAPACITY,
     KIND_FREE,
@@ -88,6 +89,83 @@ def _scatter_fn(sharding):
         return dense.at[slots].set(rows_b)
 
     return jax.jit(f, static_argnums=(2,), out_shardings=sharding)
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_sparse_step(n_slots: int, kernel: str, blk: int, sharding):
+    """Scatter + apply fused into ONE jitted donated entry — the pump's
+    dispatch unit. The legacy serving path pays two dispatches per boxcar
+    (``_scatter_fn`` then the pool step); fusing them halves the
+    per-boxcar enqueue count AND lets the whole thing compile to a single
+    AOT executable (``parallel/aot.py``) so a steady-state flush does no
+    tracing and no jit-cache lookup. The pool state (arg 0) is donated:
+    the update happens in place, no defensive copy on the hot call."""
+    from fluidframework_tpu.ops.pallas_kernel import pallas_batched_apply_ops
+
+    if kernel == "pallas" and sharding is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from fluidframework_tpu.parallel.mesh import compat_shard_map
+
+        axis = sharding.spec[0]
+
+        def per_shard(state, dense):
+            return pallas_batched_apply_ops(state, dense, block_docs=blk)
+
+        engine = compat_shard_map(
+            per_shard,
+            mesh=sharding.mesh,
+            in_specs=(P(axis), P(axis)),
+            out_specs=P(axis),
+        )
+    elif kernel == "pallas":
+        def engine(state, dense):
+            return pallas_batched_apply_ops(state, dense, block_docs=blk)
+    else:
+        engine = batched_apply_ops
+
+    def fused(state, rows_b, slots):
+        k = rows_b.shape[1]
+        dense = jnp.zeros((n_slots, k, rows_b.shape[2]), jnp.int32)
+        dense = dense.at[slots].set(rows_b)
+        if sharding is not None:
+            # Land the dense batch pre-sharded over the pool's mesh (the
+            # _scatter_fn out_shardings rule, expressed as a constraint
+            # inside the fused program).
+            dense = jax.lax.with_sharding_constraint(dense, sharding)
+        return engine(state, dense)
+
+    return jax.jit(fused, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _compact_entry(capacity: int, kernel: str, blk: int, sharding):
+    """The compact engine as one jitted donated entry per pool shape —
+    same tier split as the eager paths (the Pallas compact kernel's
+    [blk, cap, cap] permutation transport caps out at 256 rows; bigger
+    tiers compact via the XLA scatter formulation)."""
+    from fluidframework_tpu.ops.pallas_compact import pallas_batched_compact
+
+    if kernel == "pallas" and capacity <= 256 and sharding is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from fluidframework_tpu.parallel.mesh import compat_shard_map
+
+        axis = sharding.spec[0]
+
+        def per_shard(state):
+            return pallas_batched_compact(state, block_docs=blk)
+
+        fn = compat_shard_map(
+            per_shard, mesh=sharding.mesh, in_specs=(P(axis),),
+            out_specs=P(axis),
+        )
+    elif kernel == "pallas" and capacity <= 256:
+        def fn(state):
+            return pallas_batched_compact(state, block_docs=blk)
+    else:
+        fn = batched_compact
+    return jax.jit(fn, donate_argnums=(0,))
 
 
 @jax.jit
@@ -340,6 +418,7 @@ class _Pool:
             n_slots = max(n_slots, sharding.mesh.devices.size)
         self.n_slots = n_slots
         self.sharding = sharding
+        self.kernel = kernel
         self.state = self._put(_np_batched_state(n_slots, capacity))
         self.doc_of_slot = np.full(n_slots, -1, np.int32)
         # Placement generation per slot: bumped whenever the occupant
@@ -355,6 +434,50 @@ class _Pool:
         else:
             self._step = _jit_step
             self._compact = _jit_compact
+
+    def _aot_blk(self) -> int:
+        """Pallas block size for the AOT entries: the mesh rule per shard,
+        the single-device default otherwise (the kernel entry points
+        self-reduce until the doc count divides)."""
+        if self.kernel == "pallas" and self.sharding is not None:
+            return self._mesh_blk()
+        return 32
+
+    def sparse_step_aot(self, dev_rows, dev_slots) -> None:
+        """One pump dispatch: scatter + apply through the cached AOT
+        donated executable for this pool's shape bucket — zero tracing,
+        zero jit-cache lookup on the steady-state path. ``dev_rows`` is
+        the ring-staged device ``[B, K, OP_WIDTH]`` block (NOT donated:
+        a multi-tier boxcar scatters the same block into several pools);
+        ``dev_slots`` the per-row slot vector (out-of-range = dropped)."""
+        key = (
+            "fleet_sparse_step", self.capacity, self.n_slots,
+            tuple(dev_rows.shape), self.kernel, self.sharding,
+        )
+        blk = self._aot_blk()
+        self.state = aot.call(
+            key,
+            lambda: _fused_sparse_step(
+                self.n_slots, self.kernel, blk, self.sharding
+            ),
+            self.state, dev_rows, dev_slots,
+        )
+
+    def compact_aot(self) -> None:
+        """Compact through the cached AOT donated entry (the pump's
+        cadence compaction — same engine choice as ``_compact``)."""
+        key = (
+            "fleet_compact", self.capacity, self.n_slots, self.kernel,
+            self.sharding,
+        )
+        blk = self._aot_blk()
+        self.state = aot.call(
+            key,
+            lambda: _compact_entry(
+                self.capacity, self.kernel, blk, self.sharding
+            ),
+            self.state,
+        )
 
     def _mesh_blk(self) -> int:
         """Pallas block size per shard: at most 32 docs per program, and a
@@ -586,6 +709,40 @@ class DocFleet:
             )
             pool.state = pool._step(pool.state, dense)
         self.last_routing_s = routing
+
+    def dispatch_staged(self, docs, dev_rows) -> None:
+        """Apply one ring-staged boxcar: ``docs`` are external doc ids,
+        ``dev_rows`` their ``[B, K, OP_WIDTH]`` rows ALREADY RESIDENT on
+        device (the ingest ring uploaded them asynchronously while the
+        previous step computed — only the tiny per-pool slot vectors
+        cross the link at dispatch time). Row i belongs to docs[i];
+        padding rows (i >= len(docs)) route out of range and drop in the
+        scatter. Placement is resolved HERE, not at stage time, so a
+        promotion consumed from the previous health scan re-routes staged
+        rows to the doc's new pool. Each pool's scatter+apply runs as one
+        cached AOT donated executable (``_Pool.sparse_step_aot``)."""
+        b = dev_rows.shape[0]
+        t0 = time.perf_counter()
+        docs = np.asarray(docs, np.int64)
+        cap_arr, slot_arr = self._place_arrays()
+        caps = cap_arr[docs]
+        uniq = np.unique(caps[caps > 0])
+        routing = time.perf_counter() - t0
+        for cap in uniq:
+            pool = self.pools[int(cap)]
+            t0 = time.perf_counter()
+            slots = np.full(b, pool.n_slots, np.int32)  # pad = dropped
+            sel = np.flatnonzero(caps == cap)
+            slots[sel] = slot_arr[docs[sel]]
+            routing += time.perf_counter() - t0
+            pool.sparse_step_aot(dev_rows, jax.device_put(slots))
+        self.last_routing_s = routing
+
+    def compact_aot(self) -> None:
+        """Compact every pool through the cached AOT donated entries —
+        the pump's cadence compaction."""
+        for pool in self.pools.values():
+            pool.compact_aot()
 
     def begin_scan(self) -> Dict[int, object]:
         """Start an async (count, err) readback of every pool; returns a

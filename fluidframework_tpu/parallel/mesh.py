@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fluidframework_tpu.ops.merge_kernel import batched_apply_ops, batched_compact
 from fluidframework_tpu.ops.segment_state import SegmentState, make_batched_state
+from fluidframework_tpu.parallel import aot
 from fluidframework_tpu.protocol.constants import NO_CLIENT
 
 
@@ -217,20 +218,44 @@ class DocShard:
     # -- the service step -----------------------------------------------------
 
     def apply(self, ops: np.ndarray):
-        """ops: [D, K, OP_WIDTH] int32 sequenced rows (NOOP-padded)."""
+        """ops: [D, K, OP_WIDTH] int32 sequenced rows (NOOP-padded).
+
+        Dispatches through the AOT donated-entry cache
+        (``parallel/aot.py``): the mesh ``shard_map`` step is lowered and
+        compiled once per (mesh, shape) bucket, so the steady-state
+        serving loop pays neither tracing nor a jit cache lookup per
+        boxcar — the r10 zero-per-flush-tracing contract extended to the
+        mesh fleet."""
         sharded = shard_ops(jnp.asarray(ops, jnp.int32), self.mesh, self.axis)
         if self.backend == "pallas":
-            self._tables, self._scalars, stats = self._pallas_step(
-                self._tables, self._scalars, sharded
+            key = (
+                "docshard_pallas_step", self.mesh, self.axis,
+                self._interpret, tuple(self._tables.shape),
+                tuple(sharded.shape),
+            )
+            self._tables, self._scalars, stats = aot.call(
+                key, lambda: self._pallas_step,
+                self._tables, self._scalars, sharded,
             )
             return stats
-        self.state, stats = self._step(self.state, sharded)
+        key = (
+            "docshard_xla_step", self.mesh, self.axis,
+            tuple(self.state.kind.shape), tuple(sharded.shape),
+        )
+        self.state, stats = aot.call(
+            key, lambda: _jit_apply_and_stats, self.state, sharded
+        )
         return stats
 
     def compact(self) -> None:
         if self.backend == "pallas":
-            self._tables, self._scalars = self._pallas_compact(
-                self._tables, self._scalars
+            key = (
+                "docshard_pallas_compact", self.mesh, self.axis,
+                self._interpret, tuple(self._tables.shape),
+            )
+            self._tables, self._scalars = aot.call(
+                key, lambda: self._pallas_compact,
+                self._tables, self._scalars,
             )
         else:
             self.state = batched_compact(self.state)

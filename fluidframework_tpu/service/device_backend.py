@@ -14,9 +14,19 @@ error feedback are served from that device state.
 Execution model: per-document stream lambdas (``TpuDeliLambda`` in
 ``service/device_lambda.py``) decode sequenced wire ops into kernel rows
 and enqueue them here; the backend boxcars all buffered rows across the
-whole fleet into ONE batched kernel dispatch per flush (`DocFleet.apply`),
-runs the capacity lifecycle between batches, and surfaces each document's
-sticky err lane exactly once as it trips (the nack/telemetry feed).
+whole fleet into ONE batched kernel dispatch per flush, runs the capacity
+lifecycle between batches, and surfaces each document's sticky err lane
+exactly once as it trips (the nack/telemetry feed).
+
+The continuous pump (r10): in ``pump_mode`` (default) the flush path is a
+pipelined ring, not a stage→dispatch→wait sequence. Round N+1's boxcar
+assembles on host and uploads asynchronously into a double-buffered
+ingest ring slot while round N computes on device, dispatches go through
+cached AOT donated executables (``parallel/aot.py`` — zero per-flush
+tracing once the shape buckets are warm), and round N-1's one-boxcar-
+stale health scan is the only device→host readback. The target is e2e
+throughput tracking DEVICE throughput instead of dispatch count (the
+~105ms tunnel floor the r6 decomposition attributed).
 
 Replay safety: delivery upstream is at-least-once; a per-channel applied-
 sequence watermark drops already-applied rows host-side, so a crashed
@@ -27,8 +37,10 @@ offset zero (the scribe rebuild model, ``scribe/lambda.ts:106``).
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional, Tuple
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -51,6 +63,48 @@ ChannelKey = Tuple[str, str]  # (doc_id, channel address)
 _WARMED: set = set()  # (capacity, max_capacity) warmups done this process
 
 
+class _RingSlot:
+    """One staged boxcar in the ingest ring: the device-resident rows
+    (uploaded asynchronously while the previous step computes), the doc
+    routing vector (slots resolve at DISPATCH time so a promotion
+    consumed from the previous health scan re-routes staged rows), and
+    the host copy (kept for the rare sharded-overflow re-route — it is
+    the same buffer the staging pass built, so retaining it is free)."""
+
+    __slots__ = ("dev_rows", "host_rows", "docs", "lens", "rows", "traces")
+
+    def __init__(self, dev_rows, host_rows, docs, lens, rows, traces):
+        self.dev_rows = dev_rows
+        self.host_rows = host_rows
+        self.docs = docs
+        self.lens = lens
+        self.rows = rows  # real (unpadded) row count staged
+        self.traces = traces
+
+
+class IngestRing:
+    """Double-buffered (depth-N) staging ring: slot N+1 uploads while
+    slot N dispatches and slot N-1's health scan streams back. ``full``
+    is the backpressure signal — the pump dispatches the oldest staged
+    slot before staging another."""
+
+    def __init__(self, depth: int = 2):
+        self.depth = max(1, int(depth))
+        self.staged: Deque[_RingSlot] = deque()
+
+    def full(self) -> bool:
+        return len(self.staged) >= self.depth
+
+    def push(self, slot: _RingSlot) -> None:
+        self.staged.append(slot)
+
+    def pop(self) -> _RingSlot:
+        return self.staged.popleft()
+
+    def __len__(self) -> int:
+        return len(self.staged)
+
+
 class DeviceFleetBackend:
     """The service's device compute backend: one DocFleet slot per string
     channel, shared by every partition's device lambdas."""
@@ -64,6 +118,8 @@ class DeviceFleetBackend:
         sharded_overflow: bool = False,
         mesh=None,
         kernel: str = "auto",
+        pump_mode: bool = True,
+        ring_depth: int = 2,
     ):
         # ``mesh``: shard every fleet pool's document axis over a
         # jax.sharding.Mesh — the serving deployment shape (per-partition
@@ -87,10 +143,17 @@ class DeviceFleetBackend:
         self._index: Dict[ChannelKey, int] = {}
         self._keys: List[ChannelKey] = []  # dense fleet id -> key
         self.payloads: Dict[ChannelKey, dict] = {}
-        self.applied_seq: Dict[ChannelKey, int] = {}
-        # Highest seq sitting in _buffers per channel (drops live
-        # redelivery duplicates before they double-apply).
-        self._buffered_seq: Dict[ChannelKey, int] = {}
+        # Per-channel watermarks as DENSE ARRAYS indexed by fleet id (the
+        # r10 satellite: at 10k+ busy channels the per-channel dict loop
+        # in flush() was residual Python wall inside the pump —
+        # bookkeeping is now two fancy-indexed array ops per boxcar).
+        # _applied_a: highest applied seq; _buffseq_a: highest seq
+        # sitting in _buffers (drops live redelivery duplicates before
+        # they double-apply); _since_a: ops since the last summary
+        # readback (the device scribe's dirtiness signal).
+        self._applied_a = np.zeros(0, np.int64)
+        self._buffseq_a = np.zeros(0, np.int64)
+        self._since_a = np.zeros(0, np.int64)
         self._buffers: Dict[int, List[np.ndarray]] = {}
         self._buffered_rows = 0
         self._flushes = 0
@@ -112,9 +175,20 @@ class DeviceFleetBackend:
         self.flush_totals: Dict[str, float] = {
             "staging_s": 0.0, "dispatch_s": 0.0, "staged_rows": 0,
         }
-        # Per-channel ops applied since its last summary readback (the
-        # dirtiness signal the device scribe keys on).
-        self.ops_since_summary: Dict[ChannelKey, int] = {}
+        # The continuous device pump (r10): double-buffered ingest ring +
+        # AOT donated dispatch. pump_mode routes flush() through the
+        # ring; pump_mode=False keeps the legacy stage->dispatch->wait
+        # one-shot path (the parity reference the pump is pinned
+        # against). pump_busy_s is the union of dispatch->scan-readback
+        # wall intervals — 1 - busy/wall is the measured device idle
+        # fraction the bench reports.
+        self.pump_mode = pump_mode
+        self._ring = IngestRing(ring_depth)
+        self.pump_dispatches = 0
+        self.pump_backpressure = 0
+        self.pump_busy_s = 0.0
+        self._busy_edge = 0.0
+        self._scan_dispatch_t: Optional[float] = None
         # Warm the first-flush kernel shapes NOW (throwaway fleets at the
         # first few slot buckets x the minimum K bucket): the first
         # compile otherwise lands inside a serving flush — synchronous in
@@ -142,8 +216,16 @@ class DeviceFleetBackend:
                 warm.apply_sparse(
                     [0], np.zeros((1, 8, OP_WIDTH), np.int32)
                 )
+                # The pump path dispatches through the fused AOT donated
+                # entries — warm those at the same minimum buckets (the
+                # AOT cache is process-global, like the jit cache).
+                warm.dispatch_staged(
+                    [0],
+                    jax.device_put(np.zeros((1, 8, OP_WIDTH), np.int32)),
+                )
                 warm.finish_scan(warm.begin_scan())
                 warm.compact()
+                warm.compact_aot()
 
     # -- registry --------------------------------------------------------------
 
@@ -155,9 +237,29 @@ class DeviceFleetBackend:
             self._index[key] = idx
             self._keys.append(key)
             self.payloads[key] = {}
-            self.applied_seq[key] = 0
-            self.ops_since_summary[key] = 0
+            if len(self._keys) > self._applied_a.shape[0]:
+                # Amortized doubling of the watermark arrays.
+                grow = max(64, self._applied_a.shape[0])
+                pad = np.zeros(grow, np.int64)
+                self._applied_a = np.concatenate([self._applied_a, pad])
+                self._buffseq_a = np.concatenate([self._buffseq_a, pad])
+                self._since_a = np.concatenate([self._since_a, pad])
         return idx
+
+    @property
+    def applied_seq(self) -> Dict[ChannelKey, int]:
+        """Per-channel applied-seq watermarks as a dict view (the hot
+        path reads the dense array directly)."""
+        return {
+            k: int(self._applied_a[i]) for i, k in enumerate(self._keys)
+        }
+
+    @property
+    def ops_since_summary(self) -> Dict[ChannelKey, int]:
+        """Per-channel ops-since-summary dirtiness as a dict view."""
+        return {
+            k: int(self._since_a[i]) for i, k in enumerate(self._keys)
+        }
 
     def channels(self) -> List[ChannelKey]:
         return list(self._keys)
@@ -173,14 +275,11 @@ class DeviceFleetBackend:
         duplicates and drop here (idempotence under at-least-once
         delivery must hold for live redelivery of a still-buffered row,
         not just for rows already flushed)."""
-        key = (doc_id, address)
         idx = self.ensure(doc_id, address)
         seq = int(row[F_SEQ])
-        if seq <= self.applied_seq[key] or seq <= self._buffered_seq.get(
-            key, 0
-        ):
+        if seq <= self._applied_a[idx] or seq <= self._buffseq_a[idx]:
             return
-        self._buffered_seq[key] = seq
+        self._buffseq_a[idx] = seq
         self._buffers.setdefault(idx, []).append(row[None, :])
         self._buffered_rows += 1
         if self._buffered_rows >= self.max_batch:
@@ -201,10 +300,7 @@ class DeviceFleetBackend:
         rows = frame.rows
         texts = frame.texts
         n = rows.shape[0]
-        water = self.applied_seq[key]
-        bw = self._buffered_seq.get(key, 0)
-        if bw > water:
-            water = bw
+        water = max(int(self._applied_a[idx]), int(self._buffseq_a[idx]))
         skip = water - frame.first_seq + 1
         if skip > 0:
             rows = rows[skip:]
@@ -216,7 +312,7 @@ class DeviceFleetBackend:
             else:
                 origs, texts = frame.insert_payloads()
             self.payloads[key].update(zip(origs.tolist(), texts))
-        self._buffered_seq[key] = int(rows[-1, F_SEQ])
+        self._buffseq_a[idx] = int(rows[-1, F_SEQ])
         self._buffers.setdefault(idx, []).append(rows)
         self._buffered_rows += rows.shape[0]
         if self._buffered_rows >= self.max_batch:
@@ -243,12 +339,19 @@ class DeviceFleetBackend:
         channels whose sticky err lane tripped SINCE the last report (one
         boxcar stale — ``collect_now`` forces a fresh readback).
 
-        Staging is GATHERED over busy channels only (``DocFleet.
-        apply_sparse``): the host builds ``[B, K]`` for the B channels
-        with buffered rows and the device scatters that into the dense
-        batch the kernels consume — one busy channel in a 100k-channel
-        fleet stages and ships one row, not the fleet (VERDICT r3 Weak
-        #3's O(fleet) boxcar).
+        In ``pump_mode`` (the default) the boxcars route through the
+        double-buffered ingest ring and the cached AOT donated entries
+        (:meth:`pump_stage` / :meth:`pump_dispatch`): the upload of round
+        N+1 overlaps the device compute of round N and the health scan of
+        round N-1 streams back behind both — the continuous-pump serving
+        loop. ``pump_mode=False`` keeps the legacy one-shot
+        stage→dispatch→wait path as the parity reference.
+
+        Staging is GATHERED over busy channels only: the host builds
+        ``[B, K]`` for the B channels with buffered rows and the device
+        scatters that into the dense batch the kernels consume — one busy
+        channel in a 100k-channel fleet stages and ships one row, not the
+        fleet (VERDICT r3 Weak #3's O(fleet) boxcar).
 
         Health readbacks are ASYNC and one boxcar stale: each dispatch
         round starts one fused (count, err) pool scan
@@ -259,6 +362,71 @@ class DeviceFleetBackend:
         late still fires before the doc can overflow.
         ``last_flush_breakdown`` / ``flush_totals`` record where the wall
         went (host staging vs upload+dispatch)."""
+        if self.pump_mode:
+            return self._flush_pump()
+        return self._flush_oneshot()
+
+    def _stage_host(self) -> Tuple[np.ndarray, List[np.ndarray], np.ndarray]:
+        """One boxcar's host assembly, shared by the pump and one-shot
+        paths: drain the channel buffers up to each doc's chunk limit
+        (the over-limit remainder stays buffered for the next boxcar) and
+        run the watermark bookkeeping as two fancy-indexed array ops —
+        the per-channel dict loop this replaces was residual Python wall
+        inside the pump at 10k+ busy channels (r10 satellite). Returns
+        ``(idxs, rows_list, lens)``."""
+        buffers = self._buffers
+        n = len(buffers)
+        idxs = np.fromiter(buffers.keys(), np.int64, n)
+        rows_list = [
+            c[0] if len(c) == 1 else np.concatenate(c)
+            for c in buffers.values()
+        ]
+        lens = np.fromiter(
+            (r.shape[0] for r in rows_list), np.int64, n
+        )
+        # Fleet docs chunk to HALF their tier's promotion headroom:
+        # the promotion trigger is one boxcar stale, so two flushes
+        # of growth must fit between high_water and capacity
+        # (fleet.py's stated contract). Evicted/sharded docs
+        # (cap < 0) take the raw boxcar limit.
+        caps = self.fleet.doc_caps(idxs)
+        limits = np.minimum(
+            np.where(
+                caps > 0,
+                np.maximum(
+                    1,
+                    ((1 - self.fleet.high_water) * caps / 2).astype(
+                        np.int64
+                    ),
+                ),
+                self.max_batch,
+            ),
+            self.max_batch,
+        )
+        rest: Dict[int, List[np.ndarray]] = {}
+        leftover = 0
+        over = lens > limits
+        if over.any():
+            for i in np.flatnonzero(over):
+                lim = int(limits[i])
+                rest[int(idxs[i])] = [rows_list[i][lim:]]
+                rows_list[i] = rows_list[i][:lim]
+                leftover += int(lens[i]) - lim
+                lens[i] = lim
+        self._buffers = rest
+        self._buffered_rows = leftover
+        # Vectorized watermark bookkeeping: rows per channel are seq-
+        # ascending, so the applied watermark is each chunk's last row.
+        seqs = np.fromiter(
+            (r[-1, F_SEQ] for r in rows_list), np.int64, n
+        )
+        self._applied_a[idxs] = np.maximum(self._applied_a[idxs], seqs)
+        self._since_a[idxs] += lens
+        self.ops_applied += int(lens.sum())
+        return idxs, rows_list, lens
+
+    def _flush_oneshot(self) -> List[ChannelKey]:
+        """The pre-pump serving loop (the pump's parity reference)."""
         newly_errored: List[ChannelKey] = []
         staging_s = dispatch_s = 0.0
         staged_rows = 0
@@ -266,10 +434,7 @@ class DeviceFleetBackend:
             # Consume the PREVIOUS dispatch's health scan before routing
             # this round: promotion (tier moves, sharded-overflow
             # eviction) changes where a doc's rows must go.
-            if self._scan_token is not None:
-                scans = self.fleet.finish_scan(self._scan_token)
-                self._scan_token = None
-                self._consume_scan(scans, newly_errored)
+            self._consume_pending_scan(newly_errored)
             # Staging is vectorized end-to-end: a per-channel Python loop
             # here was ~30% of the serving round's host wall at 10k+ busy
             # channels. Chunk limits come from one placement-cap gather,
@@ -277,56 +442,8 @@ class DeviceFleetBackend:
             # channel shipped the same row count (the round-shaped frame
             # wire's common case).
             t0 = time.perf_counter()
-            buffers = self._buffers
-            n = len(buffers)
-            idxs = np.fromiter(buffers.keys(), np.int64, n)
-            rows_list = [
-                c[0] if len(c) == 1 else np.concatenate(c)
-                for c in buffers.values()
-            ]
-            lens = np.fromiter(
-                (r.shape[0] for r in rows_list), np.int64, n
-            )
-            # Fleet docs chunk to HALF their tier's promotion headroom:
-            # the promotion trigger is one boxcar stale, so two flushes
-            # of growth must fit between high_water and capacity
-            # (fleet.py's stated contract). Evicted/sharded docs
-            # (cap < 0) take the raw boxcar limit.
-            caps = self.fleet.doc_caps(idxs)
-            limits = np.minimum(
-                np.where(
-                    caps > 0,
-                    np.maximum(
-                        1,
-                        ((1 - self.fleet.high_water) * caps / 2).astype(
-                            np.int64
-                        ),
-                    ),
-                    self.max_batch,
-                ),
-                self.max_batch,
-            )
-            rest: Dict[int, List[np.ndarray]] = {}
-            over = lens > limits
-            if over.any():
-                for i in np.flatnonzero(over):
-                    lim = int(limits[i])
-                    rest[int(idxs[i])] = [rows_list[i][lim:]]
-                    rows_list[i] = rows_list[i][:lim]
-                    lens[i] = lim
-            self._buffers = rest
-            keys = self._keys
-            applied = self.applied_seq
-            since = self.ops_since_summary
-            total_rows = 0
-            for idx, rows in zip(idxs.tolist(), rows_list):
-                key = keys[idx]
-                seq = int(rows[-1, F_SEQ])
-                if seq > applied[key]:
-                    applied[key] = seq
-                since[key] += rows.shape[0]
-                total_rows += rows.shape[0]
-            self.ops_applied += total_rows
+            idxs, rows_list, lens = self._stage_host()
+            n = len(idxs)
             if self._sharded:
                 shard_sel = np.fromiter(
                     (int(i) in self._sharded for i in idxs), bool, n
@@ -382,19 +499,7 @@ class DeviceFleetBackend:
             if compact_now:
                 self.fleet.compact()
         self._buffered_rows = 0
-        if self._trace_pending:
-            # Sampled frames: the boxcar carrying them has been dispatched;
-            # their commit wait is the health scan begun above (or vacuous
-            # when nothing reached the fleet this flush).
-            for t in self._trace_pending:
-                tracing.stamp(t, tracing.STAGE_DEVICE, "end")
-                tracing.stamp(t, tracing.STAGE_DEVICE_COMMIT, "start")
-            if self._scan_token is None:
-                for t in self._trace_pending:
-                    tracing.stamp(t, tracing.STAGE_DEVICE_COMMIT, "end")
-            else:
-                self._trace_inflight.extend(self._trace_pending)
-            self._trace_pending = []
+        self._close_pending_traces()
         self.last_flush_breakdown = {
             "staging_s": staging_s,
             "dispatch_s": dispatch_s,
@@ -405,6 +510,189 @@ class DeviceFleetBackend:
         self.flush_totals["staged_rows"] += staged_rows
         self._unreported.extend(newly_errored)
         return newly_errored
+
+    # -- the continuous pump ---------------------------------------------------
+
+    def _flush_pump(self) -> List[ChannelKey]:
+        """flush() in pump mode: stage every buffered boxcar through the
+        ring and dispatch through the AOT donated entries. One flush call
+        still applies everything buffered (the flush contract); the
+        overlap comes from the async upload + async dispatch inside, and
+        from continuous feeders (the bench / a serving loop) calling
+        :meth:`pump_stage` / :meth:`pump_dispatch` directly so round
+        N+1's staging runs while round N computes."""
+        pre = dict(self.flush_totals)
+        newly: List[ChannelKey] = []
+        while self._buffers:
+            self.pump_stage()
+            newly.extend(self.pump_dispatch())
+        # Continuous feeders may have staged slots without dispatching.
+        newly.extend(self.pump_dispatch())
+        self._close_pending_traces()
+        self.last_flush_breakdown = {
+            key: self.flush_totals[key] - pre[key] for key in pre
+        }
+        return newly
+
+    def _close_pending_traces(self) -> None:
+        """End-of-flush trace closure, shared by both flush paths: traces
+        still pending here belong to frames whose boxcar was dispatched
+        this flush (one-shot path) or whose rows were all replay-dropped
+        (either path) — close their device span against the (possibly
+        vacuous) in-flight scan."""
+        if not self._trace_pending:
+            return
+        for t in self._trace_pending:
+            tracing.stamp(t, tracing.STAGE_DEVICE, "end")
+            tracing.stamp(t, tracing.STAGE_DEVICE_COMMIT, "start")
+        if self._scan_token is None:
+            for t in self._trace_pending:
+                tracing.stamp(t, tracing.STAGE_DEVICE_COMMIT, "end")
+        else:
+            self._trace_inflight.extend(self._trace_pending)
+        self._trace_pending = []
+
+    def pump_stage(self) -> bool:
+        """Stage ONE boxcar from the channel buffers into a ring slot:
+        host assembly plus an ASYNC device upload (``jax.device_put``
+        returns once the transfer is enqueued, so the upload overlaps the
+        previous step's device compute). A full ring is backpressure: the
+        oldest staged slot dispatches first, so at most ``ring_depth``
+        uploads are ever in flight. Returns True when a slot was
+        staged."""
+        if not self._buffers:
+            return False
+        if self._ring.full():
+            self.pump_backpressure += 1
+            self._dispatch_one()
+        t0 = time.perf_counter()
+        traces = self._trace_pending
+        self._trace_pending = []
+        for t in traces:
+            tracing.stamp(t, tracing.STAGE_RING_STAGE, "start")
+        idxs, rows_list, lens = self._stage_host()
+        n = len(idxs)
+        k = _pow2_at_least(max(int(lens.max()), 8))
+        b = _pow2_at_least(n)
+        rows_b = np.zeros((b, k, OP_WIDTH), np.int32)
+        lmax = int(lens.max())
+        if int(lens.min()) == lmax:
+            rows_b[:n, :lmax] = np.stack(rows_list)
+        else:
+            for j, rows in enumerate(rows_list):
+                rows_b[j, : rows.shape[0]] = rows
+        dev_rows = jax.device_put(rows_b)  # async upload into the slot
+        for t in traces:
+            tracing.stamp(t, tracing.STAGE_RING_STAGE, "end")
+        self._ring.push(
+            _RingSlot(dev_rows, rows_b, idxs, lens, int(lens.sum()), traces)
+        )
+        self.flush_totals["staging_s"] += time.perf_counter() - t0
+        self.flush_totals["staged_rows"] += b * k
+        return True
+
+    def pump_dispatch(self) -> List[ChannelKey]:
+        """Dispatch every staged ring slot (oldest first) through the
+        cached AOT donated entries. Returns channels whose err lane
+        tripped in the scans consumed along the way (also queued for
+        :meth:`take_errors`)."""
+        newly: List[ChannelKey] = []
+        while len(self._ring):
+            newly.extend(self._dispatch_one())
+        return newly
+
+    def _dispatch_one(self) -> List[ChannelKey]:
+        """Dispatch the oldest staged ring slot. Order per dispatch:
+        (1) consume the PREVIOUS dispatch's health scan — one boxcar
+        stale; promotions it carries re-route this slot's docs before the
+        scatter; (2) scatter+apply via the cached AOT donated executables
+        (``DocFleet.dispatch_staged`` — zero tracing, only the tiny slot
+        vectors cross the link); (3) begin this boxcar's scan. The scan
+        consumption is the pump's ONLY device→host transfer."""
+        slot = self._ring.pop()
+        newly: List[ChannelKey] = []
+        self._consume_pending_scan(newly)
+        t0 = time.perf_counter()
+        for t in slot.traces:
+            tracing.stamp(t, tracing.STAGE_DEVICE, "end")
+            tracing.stamp(t, tracing.STAGE_DEVICE_COMMIT, "start")
+            tracing.stamp(t, tracing.STAGE_DEVICE_STEP, "start")
+        in_fleet = self.fleet.doc_caps(slot.docs) > 0
+        if in_fleet.any():
+            self.fleet.dispatch_staged(slot.docs, slot.dev_rows)
+            self._scan_token = self.fleet.begin_scan()
+            self._scan_dispatch_t = time.perf_counter()
+        for t in slot.traces:
+            tracing.stamp(t, tracing.STAGE_DEVICE_STEP, "end")
+        if slot.traces:
+            if self._scan_token is None:
+                for t in slot.traces:
+                    tracing.stamp(t, tracing.STAGE_DEVICE_COMMIT, "end")
+            else:
+                self._trace_inflight.extend(slot.traces)
+        self.pump_dispatches += 1
+        self._flushes += 1
+        compact_now = self._flushes % self.compact_every == 0
+        if self._sharded and not in_fleet.all():
+            # Docs evicted into ShardedDocs (possibly by the promotion
+            # consumed moments ago): re-route their rows from the slot's
+            # retained host copy — the scatter dropped them on device.
+            for i in np.flatnonzero(~in_fleet):
+                doc = self._sharded.get(int(slot.docs[i]))
+                if doc is None:
+                    continue
+                rows = slot.host_rows[i, : int(slot.lens[i])]
+                kk = _pow2_at_least(max(rows.shape[0], 8))
+                padded = np.zeros((kk, OP_WIDTH), np.int32)
+                padded[: rows.shape[0]] = rows
+                doc.apply(padded)
+                if compact_now:
+                    doc.compact()
+                doc.rebalance()  # self-compacts when it triggers
+        if compact_now:
+            self.fleet.compact_aot()
+        routing = self.fleet.last_routing_s if in_fleet.any() else 0.0
+        self.flush_totals["dispatch_s"] += (
+            time.perf_counter() - t0 - routing
+        )
+        self.flush_totals["staging_s"] += routing
+        self._unreported.extend(newly)
+        return newly
+
+    def pump_drain(self) -> List[ChannelKey]:
+        """Shutdown drain: stage whatever is still buffered, dispatch
+        every in-flight ring slot, and barrier the final health scan. No
+        op is lost (everything buffered or staged applies before return)
+        and none duplicates (the applied-seq watermarks drop upstream
+        redelivery) — the pump's shutdown contract."""
+        newly = list(self.flush())
+        newly.extend(self.collect_now())
+        return newly
+
+    def _consume_pending_scan(self, newly: List[ChannelKey]) -> None:
+        """Consume the in-flight health scan, if any: the pump's one
+        legal readback (one boxcar stale). Also closes the traced
+        ``scan_consume`` spans and folds the dispatch→readback wall into
+        ``pump_busy_s`` (the device-idle-fraction instrument)."""
+        if self._scan_token is None:
+            return
+        for t in self._trace_inflight:
+            tracing.stamp(t, tracing.STAGE_SCAN_CONSUME, "start")
+        scans = self.fleet.finish_scan(self._scan_token)
+        self._scan_token = None
+        now = time.perf_counter()
+        if self._scan_dispatch_t is not None:
+            # Union of dispatch->readback intervals (ordered, so a
+            # running edge suffices): busy wall the device provably had
+            # work queued; 1 - busy/wall is the idle fraction.
+            start = max(self._scan_dispatch_t, self._busy_edge)
+            if now > start:
+                self.pump_busy_s += now - start
+            self._busy_edge = now
+            self._scan_dispatch_t = None
+        for t in self._trace_inflight:
+            tracing.stamp(t, tracing.STAGE_SCAN_CONSUME, "end")
+        self._consume_scan(scans, newly)
 
     def _consume_scan(
         self, scans: Dict[int, np.ndarray],
@@ -434,10 +722,8 @@ class DeviceFleetBackend:
         on an already-streaming copy."""
         if self._scan_token is None:
             return []
-        scans = self.fleet.finish_scan(self._scan_token)
-        self._scan_token = None
         newly: List[ChannelKey] = []
-        self._consume_scan(scans, newly)
+        self._consume_pending_scan(newly)
         self._unreported.extend(newly)
         return newly
 
@@ -516,7 +802,7 @@ class DeviceFleetBackend:
         self.flush()
         h = self._doc_state(self._index[key])
         n = int(h.count)
-        self.ops_since_summary[key] = 0
+        self._since_a[self._index[key]] = 0
         return {
             "lanes": {
                 lane: np.asarray(getattr(h, lane))[:n].tolist()
@@ -533,14 +819,12 @@ class DeviceFleetBackend:
         """Channels with >= threshold ops applied since their last summary
         readback — the device scribe's work list. Buffered rows count:
         flush-before-summarize is the scribe's first step anyway."""
-        pending: Dict[ChannelKey, int] = {}
+        n = len(self._keys)
+        pending = np.zeros(n, np.int64)
         for idx, chunks in self._buffers.items():
-            pending[self._keys[idx]] = sum(c.shape[0] for c in chunks)
-        return [
-            key
-            for key in self._keys
-            if self.ops_since_summary[key] + pending.get(key, 0) >= threshold
-        ]
+            pending[idx] = sum(c.shape[0] for c in chunks)
+        hot = np.flatnonzero(self._since_a[:n] + pending >= threshold)
+        return [self._keys[i] for i in hot]
 
     def _telemetry_start(self):
         """The serving-thread half of one scrape: assemble the device-side
@@ -652,5 +936,9 @@ class DeviceFleetBackend:
             sharded_rows=sum(
                 d.rows_in_use() for d in self._sharded.values()
             ),
+            pump_mode=self.pump_mode,
+            ring_staged=len(self._ring),
+            pump_dispatches=self.pump_dispatches,
+            pump_backpressure=self.pump_backpressure,
         )
         return s

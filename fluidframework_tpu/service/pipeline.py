@@ -129,6 +129,8 @@ class PipelineFluidService:
         device_flush_min_rows: int = 1,
         device_mesh=None,
         device_kernel: str = "auto",
+        device_pump: bool = True,
+        device_ring_depth: int = 2,
         foreman_tasks: tuple = ("summarizer",),
         index_sink: Optional[Any] = None,
         log: Optional[Any] = None,
@@ -228,26 +230,31 @@ class PipelineFluidService:
             self._make_device(
                 device_capacity, device_max_capacity,
                 device_sharded_overflow, device_max_batch, device_mesh,
-                device_kernel,
+                device_kernel, device_pump, device_ring_depth,
             )
 
     def _make_device(
         self, capacity: int, max_capacity: int, sharded_overflow: bool,
         max_batch: int = 512, mesh=None, kernel: str = "auto",
+        pump: bool = True, ring_depth: int = 2,
     ) -> None:
         from fluidframework_tpu.service.device_backend import (
             DeviceFleetBackend,
         )
         from fluidframework_tpu.service.device_lambda import TpuDeliLambda
 
+        # pump/ring_depth: the continuous device pump (r10) — flushes
+        # ride the double-buffered ingest ring + AOT donated entries;
+        # pump=False keeps the one-shot path (the parity reference).
         self.device = DeviceFleetBackend(
             capacity=capacity, max_capacity=max_capacity,
             sharded_overflow=sharded_overflow, max_batch=max_batch,
-            mesh=mesh, kernel=kernel,
+            mesh=mesh, kernel=kernel, pump_mode=pump,
+            ring_depth=ring_depth,
         )
         self._device_capacity = (
             capacity, max_capacity, sharded_overflow, max_batch, mesh,
-            kernel,
+            kernel, pump, ring_depth,
         )
 
         def factory(p: int, state):

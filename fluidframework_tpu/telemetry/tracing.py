@@ -25,12 +25,21 @@ from typing import Dict, List, Optional
 #   device        device-stage enqueue -> boxcar dispatch issued
 #   device_commit dispatch issued -> health-scan readback consumed
 #   broadcast     room fan-out to connected sessions
+# The continuous device pump (r10) decomposes the device residency
+# further — its three sub-stages nest inside device/device_commit:
+#   ring_stage    host boxcar assembly -> async upload into a ring slot
+#   device_step   the AOT donated dispatch call (enqueue cost, not
+#                 device compute — the number the pump drives to ~0)
+#   scan_consume  the one-boxcar-stale health-scan readback wait
 STAGE_ALFRED = "alfred"
 STAGE_DELI = "deli"
 STAGE_SCRIPTORIUM = "scriptorium"
 STAGE_DEVICE = "device"
 STAGE_DEVICE_COMMIT = "device_commit"
 STAGE_BROADCAST = "broadcast"
+STAGE_RING_STAGE = "ring_stage"
+STAGE_DEVICE_STEP = "device_step"
+STAGE_SCAN_CONSUME = "scan_consume"
 FRAME_STAGES = (
     STAGE_ALFRED,
     STAGE_DELI,
@@ -38,6 +47,9 @@ FRAME_STAGES = (
     STAGE_DEVICE,
     STAGE_DEVICE_COMMIT,
     STAGE_BROADCAST,
+    STAGE_RING_STAGE,
+    STAGE_DEVICE_STEP,
+    STAGE_SCAN_CONSUME,
 )
 
 

@@ -117,6 +117,49 @@ def test_r8_exempt_from_observability_keys(tmp_path):
     assert cba.check(str(tmp_path)) == 0
 
 
+def test_r10_requires_pump_keys(tmp_path):
+    """An r10+ artifact must carry the continuous-pump pair — the
+    parity-pinned pump throughput and the measured device idle fraction
+    — on top of every earlier gated key."""
+    cba = _tool()
+    prior = {
+        "pipeline_serving_ops_per_sec": 2,
+        "deli_scribe_e2e_ops_per_sec": 3,
+        "fleet_mesh_ops_per_sec": 4,
+        "tree_moves_device_fraction": 0.97,
+        "serving_stage_spans_ms": {"deli": 0.2, "total": 4.5},
+        "device_shard_occupancy": {"128": [5, 5, 5, 5]},
+    }
+    _write(tmp_path, "BENCH_r10.json", [json.dumps(prior)])
+    assert cba.check(str(tmp_path)) == 1
+    # One of the pair is not enough.
+    _write(tmp_path, "BENCH_r10.json", [json.dumps(dict(
+        prior, serving_pump_ops_per_sec=123456,
+    ))])
+    assert cba.check(str(tmp_path)) == 1
+    _write(tmp_path, "BENCH_r10.json", [json.dumps(dict(
+        prior,
+        serving_pump_ops_per_sec=123456,
+        serving_pump_device_idle_frac=0.12,
+    ))])
+    assert cba.check(str(tmp_path)) == 0
+
+
+def test_r9_exempt_from_pump_keys(tmp_path):
+    """Per-key since-round gating: an r9 artifact predates the pump pair
+    and passes with the six prior keys."""
+    cba = _tool()
+    _write(tmp_path, "BENCH_r09.json", [json.dumps({
+        "pipeline_serving_ops_per_sec": 2,
+        "deli_scribe_e2e_ops_per_sec": 3,
+        "fleet_mesh_ops_per_sec": 4,
+        "tree_moves_device_fraction": 0.97,
+        "serving_stage_spans_ms": {"deli": 0.2, "total": 4.5},
+        "device_shard_occupancy": {"128": [5, 5, 5, 5]},
+    })])
+    assert cba.check(str(tmp_path)) == 0
+
+
 def test_newest_round_governs(tmp_path):
     cba = _tool()
     _write(tmp_path, "BENCH_r05.json", ['{"metric": "old"}'])

@@ -173,6 +173,39 @@ def test_host_sync_telemetry_slice_readback_pragma(tmp_path):
     assert annotated == []
 
 
+def test_host_sync_pump_scan_consume_readback_pragma(tmp_path):
+    """The r10 pump's ONLY legal readback: consuming the one-boxcar-
+    stale health scan. The np.asarray over the jitted scan result IS a
+    device→host transfer — flagged bare, suppressed by the reasoned
+    one-readback-per-round pragma the production pump carries."""
+    _, HostSync, *_ = _tools()
+    snippet = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def _pool_scan(state):
+        return jnp.stack([state.count, state.err])
+
+    def pump_round(pool, staged_rows):
+        dev = _pool_scan(pool.state)  # begin_scan: async, no transfer
+        host = np.asarray(dev){pragma}
+        return host
+    """
+    bare = _run_pass(HostSync, snippet.format(pragma=""), tmp_path)
+    assert len(bare) == 1 and "device→host" in bare[0].message
+    annotated = _run_pass(
+        HostSync,
+        snippet.format(
+            pragma="  # graftlint: readback(the pump's one-boxcar-stale"
+            " health scan — the only device→host transfer per round)"
+        ),
+        tmp_path,
+    )
+    assert annotated == []
+
+
 # -- recompile-hazard ----------------------------------------------------------
 
 
@@ -254,6 +287,58 @@ def test_recompile_flags_traced_branch_not_static(tmp_path):
     assert len(findings) == 1
     assert "traced value" in findings[0].message
     assert "'x'" in findings[0].message or " x " in findings[0].message
+
+
+def test_recompile_flags_aot_entry_built_per_flush(tmp_path):
+    """TP: an AOT entry lowered+compiled inside the per-flush dispatch
+    function rebuilds the executable every flush — the exact hazard the
+    parallel/aot.py shape-bucket cache exists to prevent."""
+    _, _, Recompile, *_ = _tools()
+    findings = _run_pass(
+        Recompile,
+        """
+        import jax
+
+        def dispatch(state, rows, slots):
+            exe = jax.jit(lambda s, r, i: s).lower(
+                state, rows, slots
+            ).compile()
+            return exe(state, rows, slots)
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 2  # the jit ctor AND the lower().compile()
+    assert all("per call" in f.message for f in findings)
+
+
+def test_recompile_aot_shape_bucket_cache_is_accepted(tmp_path):
+    """TN/pragma: the production AOT pattern — lru_cache jitted builders
+    plus a dict-probe entry cache whose build branch carries the reasoned
+    recompile pragma (parallel/aot.py) — survives the pass clean, pinning
+    that entries are built once per shape bucket, never per flush."""
+    _, _, Recompile, *_ = _tools()
+    findings = _run_pass(
+        Recompile,
+        """
+        import functools
+        import jax
+
+        _ENTRIES = {}
+
+        @functools.lru_cache(maxsize=None)
+        def _fused_step(n_slots):
+            return jax.jit(lambda s, r, i: s, donate_argnums=(0,))
+
+        def call(key, build, *args):
+            exe = _ENTRIES.get(key)
+            if exe is None:
+                # graftlint: recompile(built ONCE per shape-bucket key — the dict probe above IS the cache)
+                exe = _ENTRIES[key] = build().lower(*args).compile()
+            return exe(*args)
+        """,
+        tmp_path,
+    )
+    assert findings == []
 
 
 # -- determinism ---------------------------------------------------------------

@@ -28,7 +28,8 @@ from typing import List, Tuple
 # r6; the device-native move-marks fraction (config 3c-moves) in r7; the
 # observability pair — the sampled-frame per-stage latency decomposition
 # and the per-shard device occupancy lanes from the single-readback
-# telemetry scrape — in r9.
+# telemetry scrape — in r9; the continuous-pump pair — parity-pinned pump
+# throughput and the measured device idle fraction — in r10.
 REQUIRED = (
     ("pipeline_serving_ops_per_sec", 6),
     ("deli_scribe_e2e_ops_per_sec", 6),
@@ -36,6 +37,8 @@ REQUIRED = (
     ("tree_moves_device_fraction", 7),
     ("serving_stage_spans_ms", 9),
     ("device_shard_occupancy", 9),
+    ("serving_pump_ops_per_sec", 10),
+    ("serving_pump_device_idle_frac", 10),
 )
 # Artifacts up to round 5 predate every gated metric.
 BASELINE_ROUND = 5
