@@ -55,7 +55,10 @@ from fluidframework_tpu.parallel.fleet import (
     split_telemetry,
 )
 from fluidframework_tpu.protocol.constants import F_ARG, F_SEQ, OP_WIDTH
+from fluidframework_tpu.service import retry
 from fluidframework_tpu.telemetry import metrics, tracing
+from fluidframework_tpu.testing import faults
+from fluidframework_tpu.testing.faults import inject_fault
 from fluidframework_tpu.utils import pow2_at_least as _pow2_at_least
 
 ChannelKey = Tuple[str, str]  # (doc_id, channel address)
@@ -524,7 +527,20 @@ class DeviceFleetBackend:
         pre = dict(self.flush_totals)
         newly: List[ChannelKey] = []
         while self._buffers:
-            self.pump_stage()
+            try:
+                self.pump_stage()
+            except faults.InjectedFault as e:
+                # Fault at the staging boundary: every row is still
+                # buffered (fail / crash-before) or ring-staged
+                # (crash-after), so the next flush or pump_drain()
+                # replays it — counted, never silent. A fault from a
+                # NESTED boundary (the backpressure dispatch) already
+                # counted itself under its own site.
+                if e.site == "pump.stage":
+                    retry.retry_counter().inc(
+                        site="pump.stage", outcome="requeue"
+                    )
+                raise
             newly.extend(self.pump_dispatch())
         # Continuous feeders may have staged slots without dispatching.
         newly.extend(self.pump_dispatch())
@@ -552,6 +568,7 @@ class DeviceFleetBackend:
             self._trace_inflight.extend(self._trace_pending)
         self._trace_pending = []
 
+    @inject_fault("pump.stage")
     def pump_stage(self) -> bool:
         """Stage ONE boxcar from the channel buffers into a ring slot:
         host assembly plus an ASYNC device upload (``jax.device_put``
@@ -559,7 +576,16 @@ class DeviceFleetBackend:
         previous step's device compute). A full ring is backpressure: the
         oldest staged slot dispatches first, so at most ``ring_depth``
         uploads are ever in flight. Returns True when a slot was
-        staged."""
+        staged.
+
+        Crash-at-boundary contract (the ``pump.stage`` site): a crash
+        BEFORE staging leaves every row in the channel buffers; a crash
+        AFTER leaves the staged slot in the ring with its watermarks
+        advanced. Either way :meth:`pump_drain` replays exactly what is
+        buffered-or-staged — no op lost, none duplicated. When the ring
+        is full, the backpressure dispatch runs BEFORE any staging work,
+        so an injected dispatch failure can never drop the boxcar being
+        staged (it is still entirely in the buffers)."""
         if not self._buffers:
             return False
         if self._ring.full():
@@ -601,6 +627,29 @@ class DeviceFleetBackend:
             newly.extend(self._dispatch_one())
         return newly
 
+    @inject_fault("pump.dispatch")
+    def _dispatch_device(self, docs, dev_rows) -> None:
+        """The device half of one ring-slot dispatch — the ``pump.dispatch``
+        injection boundary. The boundary wraps the AOT dispatch alone;
+        an INJECTED fault fires before the dispatch runs, so the caller's
+        fallback provably re-applies un-applied rows only. Scan-begin
+        runs after either path in the caller; a crash that skips it is
+        covered by the next dispatch's scan (err lanes are sticky and
+        counts are current-state reads)."""
+        self.fleet.dispatch_staged(docs, dev_rows)
+
+    def _dispatch_fallback(self, slot: _RingSlot, in_fleet: np.ndarray) -> None:
+        """Device dispatch failed: apply the slot through the one-shot
+        host-staged path (``DocFleet.apply_sparse``) from the RETAINED
+        host copy — the staged boxcar is never dropped, and the recovery
+        is never silent (``retry_attempts_total{pump.dispatch,fallback}``).
+        Watermarks advanced at stage time and the slot is consumed exactly
+        once, so the fallback preserves no-lost/no-dup by construction."""
+        retry.retry_counter().inc(site="pump.dispatch", outcome="fallback")
+        n = len(slot.docs)
+        sel = np.flatnonzero(in_fleet)
+        self.fleet.apply_sparse(slot.docs[sel], slot.host_rows[:n][sel])
+
     def _dispatch_one(self) -> List[ChannelKey]:
         """Dispatch the oldest staged ring slot. Order per dispatch:
         (1) consume the PREVIOUS dispatch's health scan — one boxcar
@@ -619,7 +668,44 @@ class DeviceFleetBackend:
             tracing.stamp(t, tracing.STAGE_DEVICE_STEP, "start")
         in_fleet = self.fleet.doc_caps(slot.docs) > 0
         if in_fleet.any():
-            self.fleet.dispatch_staged(slot.docs, slot.dev_rows)
+            try:
+                self._dispatch_device(slot.docs, slot.dev_rows)
+            except faults.InjectedCrash as e:
+                # Crash mid-dispatch: if the dispatch never executed the
+                # staged slot must survive to the drain (pump_drain
+                # replays it; watermarks advanced at stage time, so the
+                # replay applies exactly once). A crash AFTER the
+                # dispatch leaves the applied state authoritative —
+                # requeueing then would double-apply.
+                if not e.completed:
+                    self._ring.staged.appendleft(slot)
+                    retry.retry_counter().inc(
+                        site="pump.dispatch", outcome="requeue"
+                    )
+                else:
+                    # The dispatch landed; the crash only cost the ack.
+                    # Nothing to recover — surfaced to the supervisor.
+                    retry.retry_counter().inc(
+                        site="pump.dispatch", outcome="fatal"
+                    )
+                raise
+            except faults.InjectedFault:
+                # Injected dispatch failure: the wrapper fires BEFORE any
+                # device work, so the fallback can re-apply the slot from
+                # its host copy with no double-apply risk.
+                self._dispatch_fallback(slot, in_fleet)
+            except Exception:
+                # A REAL dispatch failure may have applied a PREFIX of
+                # the slot's pools (dispatch_staged loops per pool), so
+                # neither an in-place fallback nor a requeue can avoid
+                # double-applying what landed. Surface it: the device
+                # stage's documented recovery is the cold restart +
+                # deltas-log replay (crash_device), which rebuilds every
+                # channel replica exactly.
+                retry.retry_counter().inc(
+                    site="pump.dispatch", outcome="fatal"
+                )
+                raise
             self._scan_token = self.fleet.begin_scan()
             self._scan_dispatch_t = time.perf_counter()
         for t in slot.traces:
@@ -664,7 +750,14 @@ class DeviceFleetBackend:
         every in-flight ring slot, and barrier the final health scan. No
         op is lost (everything buffered or staged applies before return)
         and none duplicates (the applied-seq watermarks drop upstream
-        redelivery) — the pump's shutdown contract."""
+        redelivery) — the pump's shutdown contract.
+
+        The contract extends to the injected-crash case (r11): a crash at
+        the ``pump.stage`` boundary leaves every row either buffered or
+        ring-staged, and a pre-dispatch crash at ``pump.dispatch``
+        requeues its slot at the ring head — so one drain after the crash
+        replays exactly the staged rows, bit-identical to an un-faulted
+        run (tests/test_faults.py pins this)."""
         newly = list(self.flush())
         newly.extend(self.collect_now())
         return newly

@@ -35,8 +35,10 @@ from fluidframework_tpu.protocol.types import (
     NackMessage,
     SequencedDocumentMessage,
 )
+from fluidframework_tpu.service import retry
 from fluidframework_tpu.service.queue import PartitionedLog
 from fluidframework_tpu.telemetry import LumberEventName, Lumberjack, metrics, tracing
+from fluidframework_tpu.testing.faults import inject_fault
 from fluidframework_tpu.service.sequencer import (
     DocumentSequencer,
     SequencerCheckpoint,
@@ -295,16 +297,24 @@ class PartitionRunner:
         return n
 
     def _emit(self, outs: List[Tuple[str, str, Any]]) -> None:
+        # Produce failures (the ``queue.send`` boundary) retry with
+        # backoff: the in-proc log's boxcar append is atomic w.r.t. the
+        # injection boundary, so a retried batch never half-lands; an
+        # exhausted retry raises BEFORE the offset advances — the chunk
+        # replays and deli's deterministic re-production plus downstream
+        # dedup absorb it (the documented at-least-once model).
         by_topic: Dict[str, List[Tuple[str, Any]]] = {}
         for out_topic, out_key, out_value in outs:
             by_topic.setdefault(out_topic, []).append((out_key, out_value))
         for topic, entries in by_topic.items():
             send_batch = getattr(self.log, "send_batch", None)
             if send_batch is not None:
-                send_batch(topic, entries)
+                retry.call_with_retry("queue.send", send_batch, topic, entries)
             else:  # minimal log impls (native binding) only expose send
                 for key, value in entries:
-                    self.log.send(topic, key, value)
+                    retry.call_with_retry(
+                        "queue.send", self.log.send, topic, key, value
+                    )
 
     def checkpoint(self, partition: Optional[int] = None) -> None:
         parts = range(self.log.n_partitions) if partition is None else [partition]
@@ -582,12 +592,14 @@ class DocOpLog:
         self._starts: List[int] = []  # frames[i].first_seq (bisect key)
         self.head = 0  # highest stored seq (O(1) doc_head probe)
 
+    @inject_fault("store.append")
     def add_msg(self, msg: SequencedDocumentMessage) -> None:
         seq = msg.sequence_number
         self.ops[seq] = msg
         if seq > self.head:
             self.head = seq
 
+    @inject_fault("store.append")
     def add_frame(self, frame) -> None:
         if frame.last_seq <= self.head:
             return  # replay duplicate: identical re-production, drop
@@ -647,7 +659,15 @@ class DocOpLog:
 
 class ScriptoriumLambda(PartitionLambda):
     """Idempotent insert of sequenced ops keyed by (doc, seq): one
-    :class:`DocOpLog` per document, frames stored whole."""
+    :class:`DocOpLog` per document, frames stored whole.
+
+    Recovery contract for the ``store.append`` boundary: appends retry
+    with jittered backoff (``service/retry.py`` — the append is
+    idempotent under the head watermark, so a retry of a half-observed
+    failure cannot double-store); EXHAUSTED retries raise through the
+    runner, whose offset then never advances past the frame — the record
+    replays on the next pump (at-least-once), so no sequenced op is ever
+    lost to a store outage and none duplicates."""
 
     wants = frozenset({"seq", "seqframe"})
 
@@ -662,12 +682,16 @@ class ScriptoriumLambda(PartitionLambda):
 
     def handler(self, key: str, value: dict) -> List[Tuple[str, str, Any]]:
         if value["t"] == "seq":
-            self._doc(key).add_msg(value["msg"])
+            retry.call_with_retry(
+                "store.append", self._doc(key).add_msg, value["msg"]
+            )
         elif value["t"] == "seqframe":
             traces = value.get("traces")
             if traces is not None:
                 tracing.stamp(traces, tracing.STAGE_SCRIPTORIUM, "start")
-            self._doc(key).add_frame(value["frame"])
+            retry.call_with_retry(
+                "store.append", self._doc(key).add_frame, value["frame"]
+            )
             if traces is not None:
                 tracing.stamp(traces, tracing.STAGE_SCRIPTORIUM, "end")
         return []
@@ -686,11 +710,15 @@ class ScriptoriumLambda(PartitionLambda):
                 log = store.get(rec.key)
                 if log is None:
                     log = store[rec.key] = DocOpLog()
-                log.add_frame(value["frame"])
+                retry.call_with_retry(
+                    "store.append", log.add_frame, value["frame"]
+                )
                 if traces is not None:
                     tracing.stamp(traces, tracing.STAGE_SCRIPTORIUM, "end")
             elif t == "seq":
-                self._doc(rec.key).add_msg(value["msg"])
+                retry.call_with_retry(
+                    "store.append", self._doc(rec.key).add_msg, value["msg"]
+                )
         return []
 
     def state(self) -> Any:

@@ -31,11 +31,13 @@ from fluidframework_tpu.protocol.types import (
     NackMessage,
     SequencedDocumentMessage,
 )
+from fluidframework_tpu.service import retry
 from fluidframework_tpu.service.pipeline import ReservationManager
 from fluidframework_tpu.service.sequencer import (
     DocumentSequencer,
     SequencerCheckpoint,
 )
+from fluidframework_tpu.testing import faults
 
 
 class FencedOpLog:
@@ -311,24 +313,41 @@ class NodeCluster:
             for i in range(n_nodes)
         ]
 
+    def _try_own(self, node: OrderingNode, doc_id: str) -> bool:
+        """One ownership attempt through the fault boundary: an injected
+        coordination failure (``lease.acquire``/``lease.renew``) counts as
+        not-owned and the router retries — the next candidate (or the
+        same holder on the election pass) re-attempts, so a transient
+        coordination blip never strands a document. A real takeover is
+        still epoch-fenced either way."""
+        try:
+            return node.try_own(doc_id)
+        except faults.InjectedFault as e:
+            retry.retry_counter().inc(site=e.site, outcome="retry")
+            return False
+
     def owner(self, doc_id: str) -> OrderingNode:
         """The lease-holding node, electing one if none (or the holder is
         dead — its lease must lapse first, which the TTL guarantees)."""
         holder = self.reservations.holder(doc_id)
         if holder is not None:
             node = next((n for n in self.nodes if n.name == holder), None)
-            if node is not None and node.alive and node.try_own(doc_id):
+            if node is not None and node.alive and self._try_own(node, doc_id):
                 return node
         # Assign: spread by a STABLE hash (builtin hash is seed-randomized
-        # per process, which would make placement nondeterministic), skipping
-        # dead nodes.
+        # per process, which would make placement nondeterministic),
+        # skipping dead nodes. Two sweeps: a coordination blip on one
+        # candidate (an injected acquire/renew fault, or an ack-lost
+        # acquire whose lease the same node re-acquires on its second
+        # attempt) must not surface as a hard connection error.
         import zlib
 
         start = zlib.crc32(doc_id.encode()) % len(self.nodes)
-        for i in range(len(self.nodes)):
-            node = self.nodes[(start + i) % len(self.nodes)]
-            if node.alive and node.try_own(doc_id):
-                return node
+        for _sweep in range(2):
+            for i in range(len(self.nodes)):
+                node = self.nodes[(start + i) % len(self.nodes)]
+                if node.alive and self._try_own(node, doc_id):
+                    return node
         raise ConnectionError(f"no live node could own {doc_id!r}")
 
     # -- load-driven rebalancing (VERDICT r2 Missing #3) ---------------------
@@ -503,6 +522,19 @@ class MultiNodeFluidService:
             self.migrations.extend(self.cluster.rebalance())
         node = self.cluster.owner(doc_id)
         res = node.ticket(doc_id, client_id, msg)
+        if (
+            isinstance(res, NackMessage)
+            and res.content_code == 503
+            and "lease" in res.message
+        ):
+            # Lease expired mid-flight: the epoch fence rejected the
+            # stale owner's append (the op was never sequenced), so
+            # requeue it with the NEW owner — whose log-replay rebuild
+            # already carries this client — and it is ticketed exactly
+            # once. Never silent: retry_attempts_total{lease.renew,fence}.
+            retry.retry_counter().inc(site="lease.renew", outcome="fence")
+            node = self.cluster.owner(doc_id)
+            res = node.ticket(doc_id, client_id, msg)
         if isinstance(res, NackMessage):
             for c in self.rooms.get(doc_id, []):
                 if c.client_id == client_id:

@@ -36,10 +36,12 @@ import time
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
-from fluidframework_tpu.service import wsproto
+from fluidframework_tpu.service import retry, wsproto
 from fluidframework_tpu.service.codec import from_jsonable, to_jsonable
 from fluidframework_tpu.service.local_server import LocalFluidService
 from fluidframework_tpu.telemetry import metrics
+from fluidframework_tpu.testing import faults
+from fluidframework_tpu.testing.faults import inject_fault
 
 
 class TenantManager:
@@ -408,6 +410,44 @@ class FluidNetworkServer:
             )
         )
 
+    @inject_fault("ws.deliver")
+    def _deliver(self, session: _Session, data: bytes) -> None:
+        """One op-stream delivery write — the ``ws.deliver`` injection
+        boundary (control-plane replies go through :meth:`_send` and are
+        not injected: their recovery is the client's reconnect)."""
+        session.writer.write(data)
+
+    def _deliver_obj(self, session: _Session, obj: dict) -> None:
+        """JSON-text delivery through the injected boundary (the _send
+        encoding, minus the control-plane path)."""
+        self._deliver(
+            session,
+            wsproto.encode_frame(wsproto.OP_TEXT, json.dumps(obj).encode()),
+        )
+
+    def _requeue(self, target: list, rest: list) -> None:
+        """Delivery-failure recovery: the unsent tail goes back to the
+        HEAD of its queue so order is preserved and the next drain tick
+        retries — watermarks only advance with a successful write, so the
+        client sees each message exactly once. A crash AFTER the final
+        write of a batch leaves nothing to requeue (the tail is empty):
+        that surfaces as ``fatal``, not a phantom requeue."""
+        if rest:
+            target[:0] = rest
+            retry.retry_counter().inc(site="ws.deliver", outcome="requeue")
+        else:
+            retry.retry_counter().inc(site="ws.deliver", outcome="fatal")
+
+    @staticmethod
+    def _unsent_tail(msgs: list, j: int, exc: BaseException) -> list:
+        """Which messages still need delivery after a failed write of
+        ``msgs[j]``: a crash AFTER the write (the ack-lost window) means
+        ``msgs[j]`` reached the socket — requeueing it would deliver it
+        twice; every other failure means it never left."""
+        if isinstance(exc, faults.InjectedCrash) and exc.completed:
+            return msgs[j + 1:]
+        return msgs[j:]
+
     def _on_frame(self, session: _Session, payload: bytes) -> None:
         from fluidframework_tpu.protocol.opframe import OpFrame
 
@@ -526,7 +566,7 @@ class FluidNetworkServer:
         if dev is not None:
             now = time.monotonic()
             last = getattr(self, "_last_dev_flush", 0.0)
-            if dev._buffered_rows and now - last > 0.05:
+            if (dev._buffered_rows or len(dev._ring)) and now - last > 0.05:
                 self._last_dev_flush = now
                 dev.flush()
                 nack = getattr(self.service, "_nack_device_errors", None)
@@ -568,7 +608,27 @@ class FluidNetworkServer:
                         s.push_doc, from_seq=s.push_seq
                     )
                 for m in msgs:
-                    self._send(s, {"type": "op", "msg": to_jsonable(m)})
+                    try:
+                        self._deliver_obj(
+                            s, {"type": "op", "msg": to_jsonable(m)}
+                        )
+                    except Exception as e:
+                        # Push watermark: advance past a crash-after write
+                        # (it reached the socket), never past a lost one —
+                        # the next tick re-reads the durable log from the
+                        # watermark, so nothing is lost or re-sent. Only
+                        # a write that actually needs re-reading counts
+                        # as a requeue.
+                        if isinstance(e, faults.InjectedCrash) and e.completed:
+                            s.push_seq = max(s.push_seq, m.sequence_number)
+                            retry.retry_counter().inc(
+                                site="ws.deliver", outcome="fatal"
+                            )
+                        else:
+                            retry.retry_counter().inc(
+                                site="ws.deliver", outcome="requeue"
+                            )
+                        break
                     s.push_seq = max(s.push_seq, m.sequence_number)
                 continue
             if s.conn is None:
@@ -585,26 +645,41 @@ class FluidNetworkServer:
                     s.conn.take_inbox(pump=False)
                     if nopump else s.conn.take_inbox()
                 )
-            for m in msgs:
-                if hasattr(m, "sequence_number"):
-                    self._send(s, {"type": "op", "msg": to_jsonable(m)})
-                else:
-                    # SeqFrame: n sequenced ops in ONE binary ws frame.
-                    s.writer.write(
-                        wsproto.encode_frame(wsproto.OP_BINARY, m.encode())
-                    )
-                    self.frames_delivered += 1
+            for j, m in enumerate(msgs):
+                try:
+                    if hasattr(m, "sequence_number"):
+                        self._deliver_obj(
+                            s, {"type": "op", "msg": to_jsonable(m)}
+                        )
+                    else:
+                        # SeqFrame: n sequenced ops in ONE binary frame.
+                        self._deliver(s, wsproto.encode_frame(
+                            wsproto.OP_BINARY, m.encode()
+                        ))
+                        self.frames_delivered += 1
+                except Exception as e:
+                    self._requeue(s.conn.inbox, self._unsent_tail(msgs, j, e))
+                    break
             sigs, s.conn.signals[:] = list(s.conn.signals), []
-            for sig in sigs:
-                self._send(
-                    s,
-                    {
+            for j, sig in enumerate(sigs):
+                try:
+                    self._deliver_obj(s, {
                         "type": "signal",
                         "client_id": sig.client_id,
                         "num": sig.client_connection_number,
                         "content": sig.content,
-                    },
-                )
+                    })
+                except Exception as e:
+                    self._requeue(
+                        s.conn.signals, self._unsent_tail(sigs, j, e)
+                    )
+                    break
             nacks, s.conn.nacks[:] = list(s.conn.nacks), []
-            for nk in nacks:
-                self._send(s, {"type": "nack", "nack": to_jsonable(nk)})
+            for j, nk in enumerate(nacks):
+                try:
+                    self._deliver_obj(
+                        s, {"type": "nack", "nack": to_jsonable(nk)}
+                    )
+                except Exception as e:
+                    self._requeue(s.conn.nacks, self._unsent_tail(nacks, j, e))
+                    break

@@ -41,9 +41,11 @@ from fluidframework_tpu.service.lambdas import (
     SignalBroadcasterLambda,
     stored_message,
 )
+from fluidframework_tpu.service import retry
 from fluidframework_tpu.service.queue import PartitionedLog
 from fluidframework_tpu.service.summary_store import SummaryStore
 from fluidframework_tpu.telemetry import tracing
+from fluidframework_tpu.testing.faults import inject_fault
 
 
 class PipelineConnection:
@@ -368,6 +370,10 @@ class PipelineFluidService:
                 if self.device is not None and (
                     self.device._buffered_rows >= self.device_flush_min_rows
                     or self.device._unreported
+                    # A crash at the dispatch boundary can requeue a
+                    # staged ring slot with nothing left buffered; the
+                    # drain contract must not depend on future traffic.
+                    or len(self.device._ring)
                 ):
                     self.device.flush()
                     self._nack_device_errors()
@@ -470,7 +476,7 @@ class PipelineFluidService:
                 conn.delivered_seq = seq
         conn.delivered_seq = max(conn.delivered_seq, from_seq)
         self.rooms.setdefault(doc_id, []).append(conn)
-        self.log.send(RAW_TOPIC, doc_id, {"t": "join", "mode": mode, "token": token})
+        self._send_raw(doc_id, {"t": "join", "mode": mode, "token": token})
         self.pump()
         for msg in conn.inbox:
             # Live frame traffic from other writers may land raw
@@ -489,19 +495,25 @@ class PipelineFluidService:
             raise ConnectionError(nack.message if nack else "join failed")
         return conn
 
+    def _send_raw(self, doc_id: str, rec: dict) -> None:
+        """Front-door produce onto rawdeltas through the unified retry
+        policy: a transient ``queue.send`` failure is retried with
+        backoff; exhaustion raises to the caller — the nack analog for
+        the ingest path (the client resubmits; csn dedup at deli absorbs
+        anything that half-landed)."""
+        retry.call_with_retry("queue.send", self.log.send, RAW_TOPIC, doc_id, rec)
+
     def disconnect(self, doc_id: str, client_id: int) -> None:
         self.rooms[doc_id] = [
             c for c in self.rooms.get(doc_id, []) if c.client_id != client_id
         ]
-        self.log.send(RAW_TOPIC, doc_id, {"t": "leave", "client": client_id})
+        self._send_raw(doc_id, {"t": "leave", "client": client_id})
         self.pump()
 
     def submit(self, doc_id: str, client_id: int, msg: DocumentMessage) -> None:
         if self.trace_sampler is not None and self.trace_sampler.should_trace():
             tracing.stamp(msg.traces, "alfred", "start")
-        self.log.send(
-            RAW_TOPIC, doc_id, {"t": "op", "client": client_id, "msg": msg}
-        )
+        self._send_raw(doc_id, {"t": "op", "client": client_id, "msg": msg})
         self.pump()
 
     def submit_frame(self, doc_id: str, client_id: int, frame) -> None:
@@ -516,7 +528,7 @@ class PipelineFluidService:
             traces = self.trace_book.open()
             tracing.stamp(traces, tracing.STAGE_ALFRED, "start")
             rec["traces"] = traces
-        self.log.send(RAW_TOPIC, doc_id, rec)
+        self._send_raw(doc_id, rec)
         self.pump()
 
     def submit_frames_bulk(self, items, pump: bool = True) -> None:
@@ -545,17 +557,18 @@ class PipelineFluidService:
                 entries.append((doc_id, rec))
         send_batch = getattr(self.log, "send_batch", None)
         if send_batch is not None:
-            send_batch(RAW_TOPIC, entries)
+            retry.call_with_retry("queue.send", send_batch, RAW_TOPIC, entries)
         else:  # minimal log impls only expose send
             for key, value in entries:
-                self.log.send(RAW_TOPIC, key, value)
+                retry.call_with_retry(
+                    "queue.send", self.log.send, RAW_TOPIC, key, value
+                )
         if pump:
             self.pump()
 
     def submit_signal(self, doc_id: str, client_id: int, content) -> None:
-        self.log.send(
-            RAW_TOPIC, doc_id,
-            {"t": "signal", "client": client_id, "content": content},
+        self._send_raw(
+            doc_id, {"t": "signal", "client": client_id, "content": content}
         )
         self.pump()
 
@@ -609,6 +622,7 @@ class ReservationManager:
         self._clock = clock
         self._leases: Dict[str, dict] = {}
 
+    @inject_fault("lease.acquire")
     def acquire(self, node: str, doc_id: str, ttl_s: float) -> Optional[int]:
         """Returns the fencing epoch if granted, None if another node holds
         an unexpired lease."""
@@ -624,6 +638,7 @@ class ReservationManager:
             return epoch
         return None
 
+    @inject_fault("lease.renew")
     def renew(self, node: str, doc_id: str, ttl_s: float) -> bool:
         lease = self._leases.get(doc_id)
         if lease and lease["node"] == node and lease["expires"] > self._clock():

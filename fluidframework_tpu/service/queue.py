@@ -18,6 +18,8 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from fluidframework_tpu.testing.faults import inject_fault
+
 DEFAULT_PARTITIONS = 8  # reference config.json:38
 
 
@@ -54,6 +56,7 @@ class PartitionedLog:
             p = self._pcache[key] = partition_of(key, self.n_partitions)
         return p
 
+    @inject_fault("queue.send")
     def send(self, topic: str, key: str, value: Any) -> Tuple[int, int]:
         """Append one message; returns (partition, offset)."""
         p = self._partition(key)
@@ -62,6 +65,7 @@ class PartitionedLog:
         log.append(rec)
         return p, rec.offset
 
+    @inject_fault("queue.send")
     def send_batch(self, topic: str, entries: List[Tuple[str, Any]]) -> None:
         """Boxcar append (pendingBoxcar.ts batching): one producer call
         for a whole round of records — the bulk front door and the lambda

@@ -39,9 +39,11 @@ import socketserver
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from fluidframework_tpu.service import retry
 from fluidframework_tpu.service.codec import decode_value, encode_value
 from fluidframework_tpu.service.queue import LogRecord, partition_of
 from fluidframework_tpu.telemetry import metrics
+from fluidframework_tpu.testing.faults import inject_fault
 from fluidframework_tpu.utils.lru import LruCache
 from fluidframework_tpu.service.summary_store import SummaryStore
 
@@ -196,14 +198,8 @@ class StoreServer:
             if op == "blob.has":
                 return {"ok": True, "has": self.store.has(head["handle"])}, b""
             if op == "log.send":
-                if self._plog is not None:
-                    p, off = self._plog.send(head["topic"], head["key"], body)
-                    return {"ok": True, "partition": p, "offset": off}, b""
-                p = partition_of(head["key"], self.n_partitions)
-                log = self._logs.setdefault((head["topic"], p), [])
-                rec = LogRecord(offset=len(log), key=head["key"], value=body)
-                log.append(rec)
-                return {"ok": True, "partition": p, "offset": rec.offset}, b""
+                p, off = self._log_send(head["topic"], head["key"], body)
+                return {"ok": True, "partition": p, "offset": off}, b""
             if op == "log.read":
                 lo, limit = head["offset"], head.get("limit", 64)
                 if self._plog is not None:
@@ -274,6 +270,22 @@ class StoreServer:
             if op == "meta":
                 return {"ok": True, "n_partitions": self.n_partitions}, b""
         return {"ok": False, "error": f"unknown op {op}"}, b""
+
+    @inject_fault("store.append")
+    def _log_send(self, topic: str, key: str, body: bytes) -> Tuple[int, int]:
+        """The durable-append boundary of the store node (the Mongo/Kafka
+        write). An injected failure fires BEFORE the append, surfaces as
+        an error response, and the client adapter's retry resends; a
+        crash AFTER the append models the ack-lost window — the resend
+        then duplicates the record, which every downstream consumer
+        absorbs idempotently (the documented at-least-once model)."""
+        if self._plog is not None:
+            return self._plog.send(topic, key, body)
+        p = partition_of(key, self.n_partitions)
+        log = self._logs.setdefault((topic, p), [])
+        rec = LogRecord(offset=len(log), key=key, value=body)
+        log.append(rec)
+        return p, rec.offset
 
     def metrics_payload(self) -> bytes:
         """One complete HTTP response carrying the process registry in
@@ -355,9 +367,17 @@ class RemotePartitionedLog:
         self.n_partitions = resp["n_partitions"]
 
     def send(self, topic: str, key: str, value: Any) -> Tuple[int, int]:
-        resp, _ = self._conn.call(
+        # Remote produce rides the unified retry policy: store-node
+        # errors (including injected ``store.append`` faults) and
+        # transient socket failures resend; a resend after an ack-lost
+        # append duplicates the record, which the pipeline's replay
+        # consumers absorb idempotently (at-least-once).
+        resp, _ = retry.call_with_retry(
+            "queue.send",
+            self._conn.call,
             {"op": "log.send", "topic": topic, "key": key},
             encode_value(value),
+            retryable=(RuntimeError, ConnectionError, OSError),
         )
         return resp["partition"], resp["offset"]
 

@@ -25,6 +25,7 @@ import numpy as np
 from fluidframework_tpu.models.shared_map import SharedMap
 from fluidframework_tpu.models.shared_string import SharedString
 from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.testing import faults
 from fluidframework_tpu.tree.shared_tree import SharedTree
 
 ALPHABET = "abcdefghijklmnopqrstuvwxyz"
@@ -52,12 +53,22 @@ class LoadProfile:
     tree_weight: float = 0.0
     tree_move_weight: float = 0.35  # of tree ops, how many are moves
     doc_id: str = "load-doc"
+    # Service-side chaos (r11): per-invocation probability that an armed
+    # injection site faults (testing/faults.py FailProb, seeded from
+    # chaos_seed — deterministic schedule per run). Only sites whose
+    # recovery is transparent to clients belong here; crash-at-boundary
+    # cases live in the targeted matrix (tests/test_faults.py) where the
+    # harness plays the restart supervisor.
+    chaos_rate: float = 0.0
+    chaos_sites: tuple = ("store.append", "queue.send", "pump.dispatch")
+    chaos_seed: int = 0
 
 
 @dataclass
 class LoadReport:
     ops_submitted: int = 0
     faults_injected: int = 0
+    chaos_injected: int = 0  # service-side faults injected (chaos_rate)
     reconnects: int = 0
     nacks: int = 0
     elapsed_s: float = 0.0
@@ -74,6 +85,26 @@ class LoadReport:
         return self.ops_submitted / self.elapsed_s if self.elapsed_s else 0.0
 
 
+# r11 chaos envelopes: service-side fault injection on top of the client
+# offline windows. The smoke profile is CI-sized; the stress profile is
+# slow-marked in tests/test_load.py; the reference profile is the
+# reference ci shape (120 clients x 10k ops, test-service-load
+# testConfig.json) — the TPU-runner target the stress profile grows
+# toward.
+CHAOS_SMOKE_PROFILE = LoadProfile(
+    n_clients=16, total_ops=400, seed=13, fault_rate=0.01, offline_ops=20,
+    chaos_rate=0.02, doc_id="chaos-smoke",
+)
+CHAOS_STRESS_PROFILE = LoadProfile(
+    n_clients=48, total_ops=3000, seed=17, fault_rate=0.005, offline_ops=40,
+    chaos_rate=0.01, doc_id="chaos-stress",
+)
+CHAOS_REFERENCE_PROFILE = LoadProfile(
+    n_clients=120, total_ops=10_000, seed=23, fault_rate=0.005,
+    offline_ops=60, chaos_rate=0.01, doc_id="chaos-reference",
+)
+
+
 class LoadRunner:
     """Runs one profile against one service instance."""
 
@@ -87,6 +118,24 @@ class LoadRunner:
 
     def run(self) -> LoadReport:
         p = self.profile
+        if p.chaos_rate > 0:
+            pre_injected = faults.REGISTRY.injected_total()
+            for i, site in enumerate(p.chaos_sites):
+                faults.arm(
+                    site, faults.FailProb(p.chaos_rate, seed=p.chaos_seed + i)
+                )
+            try:
+                report = self._run(p)
+            finally:
+                for site in p.chaos_sites:
+                    faults.disarm(site)
+            report.chaos_injected = (
+                faults.REGISTRY.injected_total() - pre_injected
+            )
+            return report
+        return self._run(p)
+
+    def _run(self, p: LoadProfile) -> LoadReport:
         rng = np.random.default_rng(p.seed)
         report = LoadReport()
         t0 = time.monotonic()

@@ -160,6 +160,45 @@ def test_r9_exempt_from_pump_keys(tmp_path):
     assert cba.check(str(tmp_path)) == 0
 
 
+def test_r11_requires_fault_recovery_key(tmp_path):
+    """An r11+ artifact must carry the chaos-recovery headline — serving
+    throughput under the standard 1% fault mix, parity-asserted."""
+    cba = _tool()
+    prior = {
+        "pipeline_serving_ops_per_sec": 2,
+        "deli_scribe_e2e_ops_per_sec": 3,
+        "fleet_mesh_ops_per_sec": 4,
+        "tree_moves_device_fraction": 0.97,
+        "serving_stage_spans_ms": {"deli": 0.2, "total": 4.5},
+        "device_shard_occupancy": {"128": [5, 5, 5, 5]},
+        "serving_pump_ops_per_sec": 123456,
+        "serving_pump_device_idle_frac": 0.12,
+    }
+    _write(tmp_path, "BENCH_r11.json", [json.dumps(prior)])
+    assert cba.check(str(tmp_path)) == 1
+    _write(tmp_path, "BENCH_r11.json", [json.dumps(dict(
+        prior, fault_recovery_ops_per_sec=54321,
+    ))])
+    assert cba.check(str(tmp_path)) == 0
+
+
+def test_r10_exempt_from_fault_recovery_key(tmp_path):
+    """Per-key since-round gating: an r10 artifact predates the
+    chaos-recovery headline and passes with the eight prior keys."""
+    cba = _tool()
+    _write(tmp_path, "BENCH_r10.json", [json.dumps({
+        "pipeline_serving_ops_per_sec": 2,
+        "deli_scribe_e2e_ops_per_sec": 3,
+        "fleet_mesh_ops_per_sec": 4,
+        "tree_moves_device_fraction": 0.97,
+        "serving_stage_spans_ms": {"deli": 0.2, "total": 4.5},
+        "device_shard_occupancy": {"128": [5, 5, 5, 5]},
+        "serving_pump_ops_per_sec": 123456,
+        "serving_pump_device_idle_frac": 0.12,
+    })])
+    assert cba.check(str(tmp_path)) == 0
+
+
 def test_newest_round_governs(tmp_path):
     cba = _tool()
     _write(tmp_path, "BENCH_r05.json", ['{"metric": "old"}'])

@@ -492,6 +492,120 @@ def test_committed_lock_matches_tree():
         )
 
 
+# -- fault-site ----------------------------------------------------------------
+
+
+def _fault_site_pass():
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.graftlint.passes import FaultSitePass
+
+    return FaultSitePass
+
+
+def test_fault_site_flags_unknown_site(tmp_path):
+    findings = _run_pass(
+        _fault_site_pass(),
+        """
+        from fluidframework_tpu.testing.faults import inject_fault
+
+        @inject_fault("not.a.site")
+        def append(log, frame):
+            log.append(frame)
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "unknown injection site" in findings[0].message
+
+
+def test_fault_site_flags_non_literal_name(tmp_path):
+    findings = _run_pass(
+        _fault_site_pass(),
+        """
+        from fluidframework_tpu.testing import faults
+
+        SITE = "store.append"
+
+        @faults.inject_fault(SITE)
+        def append(log, frame):
+            log.append(frame)
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "string literal" in findings[0].message
+
+
+def test_fault_site_accepts_documented_vocabulary(tmp_path):
+    findings = _run_pass(
+        _fault_site_pass(),
+        """
+        from fluidframework_tpu.testing.faults import inject_fault
+
+        @inject_fault("store.append")
+        def append(log, frame):
+            log.append(frame)
+
+        @inject_fault("pump.dispatch")
+        def dispatch(fleet, docs, rows):
+            fleet.dispatch_staged(docs, rows)
+        """,
+        tmp_path,
+    )
+    assert findings == []
+
+
+def test_fault_site_flags_unregistered_recovery(tmp_path):
+    """A vocabulary entry whose recovery kind is not documented is a
+    production site nobody catches — a lint failure, not a latent
+    surprise."""
+    from tools.graftlint.passes import fault_site
+
+    vocab_dir = tmp_path / "fluidframework_tpu" / "testing"
+    vocab_dir.mkdir(parents=True)
+    (vocab_dir / "faults.py").write_text(
+        'SITES = {"store.append": "wishful-thinking"}\n'
+        'RECOVERY_KINDS = frozenset({"retry", "fallback"})\n'
+    )
+    p = fault_site.FaultSitePass()
+    p.scope(str(tmp_path))  # pins the fixture root for vocabulary lookup
+    src_dir = tmp_path / "mod"
+    src_dir.mkdir()
+    (src_dir / "m.py").write_text(
+        "from fluidframework_tpu.testing.faults import inject_fault\n\n"
+        '@inject_fault("store.append")\n'
+        "def append(log, frame):\n"
+        "    log.append(frame)\n"
+    )
+    core = _tools()[0]
+    src = core.ModuleSource.load(str(tmp_path), "mod/m.py")
+    findings = [f for f, _node in p.run(src)]
+    assert len(findings) == 1
+    assert "no registered recovery policy" in findings[0].message
+
+
+def test_fault_vocabulary_is_fully_registered():
+    """The REAL vocabulary: every production site maps to a documented
+    recovery kind, and every site the service decorates is declared."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.graftlint import config as glconfig
+    from tools.graftlint.passes import fault_site
+
+    sites, kinds = fault_site._parse_vocabulary(
+        os.path.join(REPO, glconfig.FAULT_VOCAB_MODULE)
+    )
+    assert sites, "vocabulary must not be empty"
+    for site, recovery in sites.items():
+        assert recovery in kinds, (site, recovery)
+    # The parsed (static) vocabulary matches the runtime one.
+    from fluidframework_tpu.testing import faults as runtime_faults
+
+    assert sites == runtime_faults.SITES
+    assert kinds == set(runtime_faults.RECOVERY_KINDS)
+
+
 # -- baseline + CI invariant ---------------------------------------------------
 
 

@@ -12,7 +12,12 @@ from fluidframework_tpu.drivers.network_driver import NetworkFluidService
 from fluidframework_tpu.service.local_server import LocalFluidService
 from fluidframework_tpu.service.network_server import FluidNetworkServer
 from fluidframework_tpu.service.pipeline import PipelineFluidService
-from fluidframework_tpu.testing.load import LoadProfile, LoadRunner
+from fluidframework_tpu.testing.load import (
+    CHAOS_SMOKE_PROFILE,
+    CHAOS_STRESS_PROFILE,
+    LoadProfile,
+    LoadRunner,
+)
 
 
 @pytest.mark.parametrize("seed", range(3))
@@ -91,6 +96,34 @@ def test_load_16_clients_2k_ops_with_moves():
     assert report.tree_moves_submitted >= 20
     assert report.faults_injected > 0
     assert report.reconnects == report.faults_injected
+
+
+def test_load_16_client_chaos_smoke():
+    """CI-sized chaos smoke (r11): 16 clients with SERVICE-side fault
+    injection (seeded FailProb on store append / queue send / device
+    dispatch) on top of client offline windows — the unified recovery
+    keeps every replica converged and the injection is never silent."""
+    report = LoadRunner(
+        PipelineFluidService(n_partitions=2), CHAOS_SMOKE_PROFILE
+    ).run()
+    assert report.converged, f"divergence: {report}"
+    assert report.ops_submitted == CHAOS_SMOKE_PROFILE.total_ops
+    assert report.chaos_injected > 0, "profile expected service faults"
+
+
+@pytest.mark.slow
+def test_load_chaos_toward_reference_profile():
+    """Growing toward the reference 120-client/10k-op ci profile
+    (testing/load.py CHAOS_REFERENCE_PROFILE is the TPU-runner target):
+    48 clients / 3k ops with 1% service-side chaos plus offline windows
+    through the full partitioned pipeline."""
+    report = LoadRunner(
+        PipelineFluidService(n_partitions=4), CHAOS_STRESS_PROFILE
+    ).run()
+    assert report.converged, f"divergence: {report}"
+    assert report.ops_submitted == CHAOS_STRESS_PROFILE.total_ops
+    assert report.chaos_injected > 0
+    assert report.faults_injected > 0
 
 
 def test_slot_recycling_under_reconnect_churn():

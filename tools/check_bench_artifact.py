@@ -29,7 +29,9 @@ from typing import List, Tuple
 # observability pair — the sampled-frame per-stage latency decomposition
 # and the per-shard device occupancy lanes from the single-readback
 # telemetry scrape — in r9; the continuous-pump pair — parity-pinned pump
-# throughput and the measured device idle fraction — in r10.
+# throughput and the measured device idle fraction — in r10; the
+# chaos-recovery headline — serving throughput under the standard 1%
+# fault mix, parity-asserted — in r11.
 REQUIRED = (
     ("pipeline_serving_ops_per_sec", 6),
     ("deli_scribe_e2e_ops_per_sec", 6),
@@ -39,6 +41,7 @@ REQUIRED = (
     ("device_shard_occupancy", 9),
     ("serving_pump_ops_per_sec", 10),
     ("serving_pump_device_idle_frac", 10),
+    ("fault_recovery_ops_per_sec", 11),
 )
 # Artifacts up to round 5 predate every gated metric.
 BASELINE_ROUND = 5

@@ -88,7 +88,7 @@ def main(argv=None) -> int:
         print(f"graftlint: {len(findings)} finding(s), "
               f"{len(stale)} stale baseline entrie(s)")
         return 1
-    print("graftlint: clean (4 passes, empty baseline)")
+    print(f"graftlint: clean ({len(ALL_PASSES)} passes, empty baseline)")
     return 0
 
 

@@ -65,6 +65,13 @@ DEVICE_ATTRS = frozenset(
 # (the kernel entry points: apply_ops_packed, unpack_state, ...).
 KERNEL_MODULE_PREFIXES = ("fluidframework_tpu.ops",)
 
+# Fault-injection scope (the fault-site pass): every package module may
+# carry ``@inject_fault`` boundaries; the testing/ package (which DEFINES
+# the vocabulary) is excluded by the pass itself. Note fnmatch's ``*``
+# crosses ``/``, so one glob covers the whole package.
+FAULT_SITE_SCOPE = ("fluidframework_tpu/*.py",)
+FAULT_VOCAB_MODULE = "fluidframework_tpu/testing/faults.py"
+
 # Committed artifacts.
 WIRE_LOCK_FILE = "api-report/wire_fingerprints.json"
 BASELINE_FILE = "tools/graftlint/baseline.json"

@@ -4,6 +4,7 @@ repo-relative files it covers), and ``run(src)`` yielding
 pragma suppression checks against."""
 
 from tools.graftlint.passes.determinism import DeterminismPass
+from tools.graftlint.passes.fault_site import FaultSitePass
 from tools.graftlint.passes.host_sync import HostSyncPass
 from tools.graftlint.passes.recompile import RecompileHazardPass
 from tools.graftlint.passes.wire_drift import WireDriftPass
@@ -12,12 +13,14 @@ ALL_PASSES = (
     HostSyncPass(),
     RecompileHazardPass(),
     DeterminismPass(),
+    FaultSitePass(),
     WireDriftPass(),
 )
 
 __all__ = [
     "ALL_PASSES",
     "DeterminismPass",
+    "FaultSitePass",
     "HostSyncPass",
     "RecompileHazardPass",
     "WireDriftPass",

@@ -1,0 +1,147 @@
+"""fault-site: every production injection site has a documented recovery.
+
+The chaos layer (``fluidframework_tpu/testing/faults.py``) threads named
+``@inject_fault("<site>")`` boundaries through production modules. Its
+correctness story depends on two invariants this pass enforces
+STATICALLY (the runtime also raises on unknown sites, but a site in a
+rarely-imported module would only trip at import time — the lint gate
+trips at commit time):
+
+- every site name used in a production module is a STRING LITERAL that
+  appears in the documented vocabulary (``faults.SITES``), so the
+  contract table in ``docs/failure-semantics.md`` can never silently lag
+  the code; and
+- every vocabulary entry maps to a registered recovery kind
+  (``faults.RECOVERY_KINDS``): an injection site whose failure nobody
+  catches is a latent outage, not a chaos harness.
+
+Like wire-drift, this pass has no pragma: the acceptance mechanism for a
+new site IS declaring it in the vocabulary (one dict entry naming its
+recovery), which the docs table and the chaos matrix then cover.
+
+The vocabulary is parsed from the faults module's AST — the pass never
+imports package code.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.graftlint import config
+from tools.graftlint.core import Finding, ModuleSource, scope_files
+
+
+def _parse_vocabulary(path: str) -> Tuple[Dict[str, str], Set[str]]:
+    """(SITES dict, RECOVERY_KINDS set) from the faults module's source —
+    both are pure literals by construction (this parse is why)."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    sites: Dict[str, str] = {}
+    kinds: Set[str] = set()
+    for node in tree.body:
+        targets: List[ast.AST] = []
+        value: Optional[ast.AST] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        names = {t.id for t in targets if isinstance(t, ast.Name)}
+        if "SITES" in names and isinstance(value, ast.Dict):
+            for k, v in zip(value.keys, value.values):
+                if isinstance(k, ast.Constant) and isinstance(
+                    v, ast.Constant
+                ):
+                    sites[str(k.value)] = str(v.value)
+        if "RECOVERY_KINDS" in names:
+            for sub in ast.walk(value):
+                if isinstance(sub, ast.Constant) and isinstance(
+                    sub.value, str
+                ):
+                    kinds.add(sub.value)
+    return sites, kinds
+
+
+def _is_inject_call(func: ast.AST) -> bool:
+    if isinstance(func, ast.Name):
+        return func.id == "inject_fault"
+    if isinstance(func, ast.Attribute):
+        return func.attr == "inject_fault"
+    return False
+
+
+class FaultSitePass:
+    id = "fault-site"
+
+    def __init__(self) -> None:
+        self._root: Optional[str] = None
+        self._vocab: Dict[str, Tuple[Dict[str, str], Set[str]]] = {}
+
+    def scope(self, root: str) -> List[str]:
+        self._root = root
+        return [
+            p
+            for p in scope_files(root, config.FAULT_SITE_SCOPE)
+            if not p.startswith("fluidframework_tpu/testing/")
+        ]
+
+    def _vocabulary(self) -> Tuple[Dict[str, str], Set[str]]:
+        root = self._root or config.REPO_ROOT
+        if root not in self._vocab:
+            path = os.path.join(root, config.FAULT_VOCAB_MODULE)
+            if not os.path.exists(path):
+                # Fixture roots without a vocabulary module validate
+                # against the repo's real one.
+                path = os.path.join(
+                    config.REPO_ROOT, config.FAULT_VOCAB_MODULE
+                )
+            self._vocab[root] = _parse_vocabulary(path)
+        return self._vocab[root]
+
+    def run(self, src: ModuleSource) -> Iterator[Tuple[Finding, ast.AST]]:
+        sites, kinds = self._vocabulary()
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not _is_inject_call(
+                node.func
+            ):
+                continue
+            if len(node.args) != 1 or not (
+                isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                yield (
+                    src.finding(
+                        self.id,
+                        node,
+                        "inject_fault site name must be a single string "
+                        "literal — the vocabulary and its recovery "
+                        "contract are checked statically",
+                    ),
+                    node,
+                )
+                continue
+            site = node.args[0].value
+            if site not in sites:
+                yield (
+                    src.finding(
+                        self.id,
+                        node,
+                        f"unknown injection site {site!r} — declare it in "
+                        "testing/faults.py SITES with its recovery "
+                        "contract (docs/failure-semantics.md)",
+                    ),
+                    node,
+                )
+            elif sites[site] not in kinds:
+                yield (
+                    src.finding(
+                        self.id,
+                        node,
+                        f"injection site {site!r} has no registered "
+                        f"recovery policy (SITES maps it to "
+                        f"{sites[site]!r}, not a documented recovery "
+                        "kind)",
+                    ),
+                    node,
+                )
