@@ -513,6 +513,154 @@ def serving_pump_benchmark(on_tpu: bool) -> dict:
     return rec
 
 
+def serving_frontdoor_benchmark(on_tpu: bool) -> dict:
+    """The r12 exit instrument: the SAME op stream through (a) the
+    quiescence-gated flush path (the r10 pump flushed once per round at
+    quiescence — the parity reference) and (b) the continuous front door
+    (``pump_feed``: the hybrid size/deadline boxcar trigger + eager
+    dispatch, never a flush on the hot path), on the dense fleet AND a
+    mesh fleet over every local device. Final pool states are parity-
+    asserted lane-for-lane before any rate is reported, and
+    ``serving_feed_latency_ms`` is the submit→device-commit residency
+    under continuous feed, measured on the trace spine (one traced frame
+    per round; the commit closes on the one-boxcar-stale scan consume,
+    so the number carries the real staleness cost, not a flattering
+    enqueue-only view)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from fluidframework_tpu.protocol.constants import (
+        F_ARG, F_LEN, F_REF, F_SEQ, F_TYPE, OP_INSERT, OP_WIDTH,
+    )
+    from fluidframework_tpu.protocol.opframe import SeqFrame
+    from fluidframework_tpu.service.device_backend import DeviceFleetBackend
+    from fluidframework_tpu.telemetry import tracing
+
+    n_ch, k, rounds, cap = (4096, 16, 12, 1024) if on_tpu else (48, 8, 6, 256)
+    compact_every = 8
+
+    base = np.zeros((n_ch, k, OP_WIDTH), np.int32)
+    base[:, :, F_TYPE] = OP_INSERT
+    base[:, :, F_LEN] = 1
+    ar = np.arange(k, dtype=np.int32)
+
+    def feed(be, r: int) -> None:
+        rows = base.copy()
+        rows[:, :, F_SEQ] = r * k + 1 + ar[None, :]
+        rows[:, :, F_REF] = r * k
+        rows[:, :, F_ARG] = r * k + 1 + ar[None, :]
+        for i in range(n_ch):
+            be.enqueue_frame(
+                f"d{i}", SeqFrame("s", 0, 1, rows[i], (), 0.0)
+            )
+
+    def run(continuous: bool, mesh=None) -> dict:
+        be = DeviceFleetBackend(
+            capacity=cap, max_batch=1 << 20, mesh=mesh, pump_mode=True,
+            compact_every=compact_every,
+            # deadline 0: every feed tick stages — the benchmark drives
+            # the ticks itself, so this measures the streaming trigger,
+            # not the bench's sleep granularity.
+            feed_deadline_ms=0.0 if continuous else 3.0,
+        )
+        traced: list = []
+
+        def step(r: int) -> None:
+            if continuous:
+                # One traced frame per round rides the feed: its spans
+                # close as the trigger stages and the stale scan lands.
+                traces: list = []
+                tracing.stamp(traces, tracing.STAGE_DEVICE, "start")
+                be.track_trace(traces)
+                feed(be, r)
+                be.pump_feed()
+                traced.append(traces)
+            else:
+                feed(be, r)
+                be.flush()  # the quiescence-gated reference
+
+        for r in range(compact_every):  # warm one compaction cadence
+            step(r)
+        if continuous:
+            be.pump_drain()
+        else:
+            be.collect_now()
+        traced.clear()
+        t0 = time.perf_counter()
+        for r in range(compact_every, compact_every + rounds):
+            step(r)
+        if continuous:
+            be.pump_drain()
+        else:
+            be.collect_now()
+        for pool in be.fleet.pools.values():
+            pool.state.count.block_until_ready()  # tunnel-honest barrier
+        wall = time.perf_counter() - t0
+        stats = be.stats()
+        assert stats["docs_with_errors"] == 0, stats
+        assert stats["ops_applied"] == n_ch * k * (rounds + compact_every)
+        lat = [tracing.spans(t)["total_ms"] for t in traced]
+        return {
+            "be": be,
+            "rate": n_ch * k * rounds / wall,
+            "lat_p50": float(np.percentile(lat, 50)) if lat else None,
+            "lat_p99": float(np.percentile(lat, 99)) if lat else None,
+            "triggers": dict(be.feed_triggers),
+        }
+
+    def parity(a, b) -> str:
+        import jax.numpy as jnp
+
+        from fluidframework_tpu.ops.segment_state import SegmentState
+
+        assert sorted(a.fleet.pools) == sorted(b.fleet.pools)
+        for capacity, pool_a in a.fleet.pools.items():
+            pool_b = b.fleet.pools[capacity]
+            for name, x, y in zip(
+                SegmentState._fields, pool_a.state, pool_b.state
+            ):
+                assert bool(jnp.array_equal(x, y)), (
+                    f"frontdoor/quiescence divergence: "
+                    f"pool {capacity} lane {name}"
+                )
+        return "ok"
+
+    quiesce = run(continuous=False)
+    cont = run(continuous=True)
+    dense_parity = parity(quiesce["be"], cont["be"])
+    rec = {
+        "serving_frontdoor_ops_per_sec": round(cont["rate"]),
+        "serving_frontdoor_quiescence_ops_per_sec": round(quiesce["rate"]),
+        "serving_frontdoor_vs_quiescence": round(
+            cont["rate"] / quiesce["rate"], 3
+        ),
+        "serving_feed_latency_ms": round(cont["lat_p50"], 3),
+        "serving_feed_latency_p99_ms": round(cont["lat_p99"], 3),
+        "serving_frontdoor_state_parity": dense_parity,
+        "serving_frontdoor_feed_triggers": cont["triggers"],
+        "serving_frontdoor_shape": f"{n_ch}x{k}x{rounds}",
+    }
+    del quiesce, cont
+    mesh = Mesh(np.array(jax.devices()), ("docs",))
+    m_quiesce = run(continuous=False, mesh=mesh)
+    m_cont = run(continuous=True, mesh=mesh)
+    rec.update({
+        "serving_frontdoor_mesh_ops_per_sec": round(m_cont["rate"]),
+        "serving_frontdoor_mesh_quiescence_ops_per_sec": round(
+            m_quiesce["rate"]
+        ),
+        "serving_frontdoor_mesh_state_parity": parity(
+            m_quiesce["be"], m_cont["be"]
+        ),
+        "serving_frontdoor_mesh_feed_latency_ms": round(
+            m_cont["lat_p50"], 3
+        ),
+        "serving_frontdoor_mesh_devices": len(mesh.devices.flat),
+    })
+    print(json.dumps({"metric": "serving_frontdoor_ops_per_sec", **rec}))
+    return rec
+
+
 def fault_recovery_benchmark(on_tpu: bool) -> dict:
     """Serving throughput under the standard 1% fault mix (r11): seeded
     FailProb(0.01) armed on ``store.append``, ``queue.send`` and
@@ -730,6 +878,12 @@ def serving_benchmarks(on_tpu: bool) -> dict:
         out.update(serving_pump_benchmark(on_tpu))
     except Exception as e:  # noqa: BLE001
         out["serving_error_pump"] = repr(e)[:500]
+    try:
+        # r12: the continuous front door vs the quiescence-gated flush —
+        # parity-pinned, with the submit→device-commit feed latency.
+        out.update(serving_frontdoor_benchmark(on_tpu))
+    except Exception as e:  # noqa: BLE001
+        out["serving_error_frontdoor"] = repr(e)[:500]
     try:
         # r11: serving throughput under the standard 1% fault mix —
         # parity-asserted recovery (the robustness substrate the fleet

@@ -759,14 +759,18 @@ class DocFleet:
             token[cap] = (dev, pool.slot_gen.copy())
         return token
 
-    def finish_scan(self, token) -> Dict[int, np.ndarray]:
+    def finish_scan(self, token, host=None) -> Dict[int, np.ndarray]:
         """Wait for a begin_scan token: cap -> [2, n_slots] host array.
         Columns for slots reassigned since begin_scan are zeroed (no
         false promotion/nack for the new occupant; the next scan sees
-        its true state)."""
+        its true state). ``host`` lets a caller that already ran the
+        blocking device→host transfer off-thread (the network server's
+        deadline ticker — DeviceFleetBackend.scan_transfer) pass the
+        per-cap host arrays in, so only the slot-generation masking —
+        which reads live pool state — runs here."""
         out = {}
         for cap, (dev, gen_snap) in token.items():
-            arr = np.array(dev)
+            arr = np.array(dev) if host is None else host[cap]
             pool = self.pools.get(cap)
             if pool is not None:
                 n = min(arr.shape[1], len(gen_snap), len(pool.slot_gen))

@@ -28,6 +28,16 @@ stale health scan is the only device→host readback. The target is e2e
 throughput tracking DEVICE throughput instead of dispatch count (the
 ~105ms tunnel floor the r6 decomposition attributed).
 
+The continuous front door (r12): boxcar FORMATION is streaming too —
+``pump_feed()`` is a hybrid size/time trigger (a boxcar stages as soon
+as it reaches ``max_batch`` OR ``feed_deadline_ms`` expires on the
+oldest buffered row, then dispatches eagerly) that the pipeline runs
+inside its pump sweep and the network server runs from a deadline
+ticker, so the device is fed while the pipeline is still busy; the
+quiescence-time flush survives only as the final drain + err-surface
+barrier. The reference's deli is the same shape: a free-running Kafka
+consumer, not a quiescence-gated one (deli/lambda.ts).
+
 Replay safety: delivery upstream is at-least-once; a per-channel applied-
 sequence watermark drops already-applied rows host-side, so a crashed
 consumer can rebuild the whole fleet by replaying the deltas log from
@@ -123,6 +133,7 @@ class DeviceFleetBackend:
         kernel: str = "auto",
         pump_mode: bool = True,
         ring_depth: int = 2,
+        feed_deadline_ms: float = 3.0,
     ):
         # ``mesh``: shard every fleet pool's document axis over a
         # jax.sharding.Mesh — the serving deployment shape (per-partition
@@ -192,6 +203,20 @@ class DeviceFleetBackend:
         self.pump_busy_s = 0.0
         self._busy_edge = 0.0
         self._scan_dispatch_t: Optional[float] = None
+        # The continuous front door (r12): boxcar formation is streaming
+        # and time-bounded, not quiescence-gated. pump_feed() stages a
+        # boxcar as soon as the buffers reach max_batch (size trigger) OR
+        # feed_deadline_ms has elapsed since the oldest buffered row
+        # arrived (deadline trigger — _feed_edge tracks that arrival),
+        # then dispatches eagerly, so socket reads, sequencing, and
+        # device compute overlap continuously. feed_triggers counts which
+        # trigger fired (benches/tests read it); _scan_prefetch holds an
+        # off-thread transfer of the in-flight scan (the network server's
+        # deadline ticker runs the blocking half off-loop).
+        self.feed_deadline_ms = float(feed_deadline_ms)
+        self._feed_edge: Optional[float] = None
+        self.feed_triggers: Dict[str, int] = {"size": 0, "deadline": 0}
+        self._scan_prefetch: Optional[Tuple[object, Dict[int, np.ndarray]]] = None
         # Warm the first-flush kernel shapes NOW (throwaway fleets at the
         # first few slot buckets x the minimum K bucket): the first
         # compile otherwise lands inside a serving flush — synchronous in
@@ -283,10 +308,12 @@ class DeviceFleetBackend:
         if seq <= self._applied_a[idx] or seq <= self._buffseq_a[idx]:
             return
         self._buffseq_a[idx] = seq
+        if not self._buffered_rows:
+            self._feed_edge = time.perf_counter()
         self._buffers.setdefault(idx, []).append(row[None, :])
         self._buffered_rows += 1
         if self._buffered_rows >= self.max_batch:
-            self.flush()
+            self._boxcar_full()
 
     def enqueue_frame(self, doc_id: str, frame) -> None:
         """Buffer a whole sequenced op frame (the batched binary wire,
@@ -316,18 +343,39 @@ class DeviceFleetBackend:
                 origs, texts = frame.insert_payloads()
             self.payloads[key].update(zip(origs.tolist(), texts))
         self._buffseq_a[idx] = int(rows[-1, F_SEQ])
+        if not self._buffered_rows:
+            self._feed_edge = time.perf_counter()
         self._buffers.setdefault(idx, []).append(rows)
         self._buffered_rows += rows.shape[0]
         if self._buffered_rows >= self.max_batch:
-            self.flush()
+            self._boxcar_full()
 
     def track_trace(self, traces: list) -> None:
         """Register a sampled frame's trace list: its ``device`` span ends
-        (and ``device_commit`` begins) when the next flush dispatches its
-        boxcar; ``device_commit`` ends when that boxcar's health scan is
-        consumed — the same one-boxcar-stale cadence the nack path rides,
-        stamped, never an extra readback."""
+        (and ``device_commit`` begins) when the next flush or feed
+        dispatches its boxcar; ``device_commit`` ends when that boxcar's
+        health scan is consumed — the same one-boxcar-stale cadence the
+        nack path rides, stamped, never an extra readback. ``feed_wait``
+        opens here and closes when the feed trigger (boxcar full or
+        deadline expired) stages the row's boxcar — the buffered wait the
+        r12 deadline bounds."""
+        tracing.stamp(traces, tracing.STAGE_FEED_WAIT, "start")
         self._trace_pending.append(traces)
+
+    def _boxcar_full(self) -> None:
+        """The enqueue-time size trigger: in pump mode a full boxcar
+        rides the continuous feed (stage + eager dispatch — the size
+        half of the r12 hybrid trigger); the one-shot path keeps its
+        legacy full flush. An injected fault in the tick is counted and
+        absorbed — by the time it propagates every nested site's
+        recovery already ran (rows buffered, slot requeued, or fallback
+        applied), so the next tick or the quiescence flush re-fires and
+        an injected tick failure never tears down the ingest path that
+        hosted it."""
+        if self.pump_mode:
+            self.pump_feed_absorbed()
+        else:
+            self.flush()
 
     # -- the boxcar step -------------------------------------------------------
 
@@ -418,6 +466,9 @@ class DeviceFleetBackend:
                 lens[i] = lim
         self._buffers = rest
         self._buffered_rows = leftover
+        # Deadline re-arms from now for chunk-limit leftovers (they just
+        # got a boxcar; the next fires within one more deadline window).
+        self._feed_edge = time.perf_counter() if leftover else None
         # Vectorized watermark bookkeeping: rows per channel are seq-
         # ascending, so the applied watermark is each chunk's last row.
         seqs = np.fromiter(
@@ -527,20 +578,7 @@ class DeviceFleetBackend:
         pre = dict(self.flush_totals)
         newly: List[ChannelKey] = []
         while self._buffers:
-            try:
-                self.pump_stage()
-            except faults.InjectedFault as e:
-                # Fault at the staging boundary: every row is still
-                # buffered (fail / crash-before) or ring-staged
-                # (crash-after), so the next flush or pump_drain()
-                # replays it — counted, never silent. A fault from a
-                # NESTED boundary (the backpressure dispatch) already
-                # counted itself under its own site.
-                if e.site == "pump.stage":
-                    retry.retry_counter().inc(
-                        site="pump.stage", outcome="requeue"
-                    )
-                raise
+            self._pump_stage_counted()
             newly.extend(self.pump_dispatch())
         # Continuous feeders may have staged slots without dispatching.
         newly.extend(self.pump_dispatch())
@@ -559,6 +597,7 @@ class DeviceFleetBackend:
         if not self._trace_pending:
             return
         for t in self._trace_pending:
+            tracing.stamp(t, tracing.STAGE_FEED_WAIT, "end")
             tracing.stamp(t, tracing.STAGE_DEVICE, "end")
             tracing.stamp(t, tracing.STAGE_DEVICE_COMMIT, "start")
         if self._scan_token is None:
@@ -567,6 +606,22 @@ class DeviceFleetBackend:
         else:
             self._trace_inflight.extend(self._trace_pending)
         self._trace_pending = []
+
+    def _pump_stage_counted(self) -> bool:
+        """Stage one boxcar with the ``pump.stage`` recovery accounting:
+        a fault at the staging boundary leaves every row still buffered
+        (fail / crash-before) or ring-staged (crash-after), so the next
+        flush, feed tick, or pump_drain() replays it — counted, never
+        silent. A fault from a NESTED boundary (the backpressure
+        dispatch) already counted itself under its own site."""
+        try:
+            return self.pump_stage()
+        except faults.InjectedFault as e:
+            if e.site == "pump.stage":
+                retry.retry_counter().inc(
+                    site="pump.stage", outcome="requeue"
+                )
+            raise
 
     @inject_fault("pump.stage")
     def pump_stage(self) -> bool:
@@ -595,6 +650,7 @@ class DeviceFleetBackend:
         traces = self._trace_pending
         self._trace_pending = []
         for t in traces:
+            tracing.stamp(t, tracing.STAGE_FEED_WAIT, "end")
             tracing.stamp(t, tracing.STAGE_RING_STAGE, "start")
         idxs, rows_list, lens = self._stage_host()
         n = len(idxs)
@@ -762,6 +818,150 @@ class DeviceFleetBackend:
         newly.extend(self.collect_now())
         return newly
 
+    # -- the continuous front door (r12) ---------------------------------------
+
+    @inject_fault("pump.feed")
+    def pump_feed(self) -> List[ChannelKey]:
+        """The streaming boxcar trigger: stage the buffered rows as soon
+        as they reach ``max_batch`` (size trigger) OR ``feed_deadline_ms``
+        has elapsed since the oldest buffered row arrived (deadline
+        trigger), then dispatch every staged ring slot eagerly — so
+        socket reads, sequencing, and device compute overlap continuously
+        instead of in pump-then-flush phases. Between triggers this is a
+        cheap no-op (two comparisons); callers — the pipeline's pump
+        sweep after each tpu-deli ingest, and the network server's
+        deadline ticker — can run it every tick.
+
+        The one-shot parity contract is unchanged: a feed stages through
+        the SAME ``pump_stage``/``_dispatch_one`` machinery as flush(),
+        so continuous-feed state is bit-exact against the quiescence
+        path, the scan stays one boxcar stale, and ``pump_drain()``
+        remains the shutdown barrier.
+
+        Crash contract (the ``pump.feed`` site,
+        docs/failure-semantics.md): a crash at this boundary leaves every
+        row buffered (fail / crash-before — the next tick re-fires over
+        exactly those rows) or the feed complete (crash-after — nothing
+        to recover); the stage-time watermarks prevent duplicates either
+        way."""
+        if self._buffers:
+            trigger = None
+            if self._buffered_rows >= self.max_batch:
+                trigger = "size"
+            elif (
+                self._feed_edge is not None
+                and time.perf_counter() - self._feed_edge
+                >= self.feed_deadline_ms / 1e3
+            ):
+                trigger = "deadline"
+            if trigger is not None:
+                self.feed_triggers[trigger] += 1
+                self._pump_stage_counted()
+                # Chunk-limit leftovers at or above a full boxcar keep
+                # staging now; sub-boxcar remainders ride the re-armed
+                # deadline (promotion headroom guarantees two boxcars of
+                # growth fit between high_water and capacity).
+                while self._buffers and (
+                    self._buffered_rows >= self.max_batch
+                ):
+                    self.feed_triggers["size"] += 1
+                    self._pump_stage_counted()
+        # Eager dispatch: every staged slot (including one requeued by a
+        # dispatch crash) goes to the device now, freeing its ring slot
+        # for the next stage's async upload.
+        return self.pump_dispatch()
+
+    def pump_feed_counted(self) -> List[ChannelKey]:
+        """:meth:`pump_feed` with the ``pump.feed`` site's recovery
+        accounting: a fault at the feed boundary leaves the rows
+        buffered for the next tick to re-fire over (``requeue``), a
+        crash-after leaves the feed complete with only the ack lost
+        (``fatal``) — counted, never silent. Faults from NESTED
+        boundaries (pump.stage / pump.dispatch) already counted
+        themselves at their own catch sites and pass through."""
+        try:
+            return self.pump_feed()
+        except faults.InjectedFault as e:
+            if e.site == "pump.feed":
+                outcome = (
+                    "fatal"
+                    if isinstance(e, faults.InjectedCrash) and e.completed
+                    else "requeue"
+                )
+                retry.retry_counter().inc(
+                    site="pump.feed", outcome=outcome
+                )
+            raise
+
+    def pump_feed_absorbed(self) -> List[ChannelKey]:
+        """One OPPORTUNISTIC feed tick: :meth:`pump_feed_counted` with
+        any injected fault absorbed. By the time a fault propagates to
+        here every nested site's recovery already ran and was counted
+        (rows buffered, slot requeued, or fallback applied), and the
+        quiescence flush / next tick is the correctness backstop — so a
+        counted tick failure must never tear down the submit path,
+        ingest path, or socket that happened to host it. This is THE
+        absorb point for every feed caller (enqueue size trigger,
+        pipeline pump sweep, network deadline ticker)."""
+        try:
+            return self.pump_feed_counted()
+        except faults.InjectedFault:
+            return []
+
+    def needs_flush(self, min_rows: int = 1) -> bool:
+        """True when a flush would do work: buffered rows at/above
+        ``min_rows``, staged ring slots (possibly requeued by a crash —
+        the drain contract must not depend on future traffic), or err
+        channels not yet surfaced. The pipeline's quiescence branch and
+        the network server's tickers gate on THIS instead of poking
+        ``_buffered_rows``/``_ring`` privates."""
+        return (
+            self._buffered_rows >= max(1, int(min_rows))
+            or len(self._ring) > 0
+            or bool(self._unreported)
+        )
+
+    def needs_scan_drain(self) -> bool:
+        """True when a health scan is still streaming back: its capacity
+        errors must surface on the ingestion path even if the stream goes
+        idle, so idle tickers barrier it (``collect_now``)."""
+        return self._scan_token is not None
+
+    def prefetch_scan(self):
+        """The in-flight scan token still needing its off-loop transfer,
+        or None — the handle an async server passes to
+        :meth:`scan_transfer` OFF the serving thread. A token whose
+        prefetch is already installed (transferred on an earlier tick
+        but not yet consumed by a feed) returns None, so an idle ticker
+        never re-runs the same transfer."""
+        if (
+            self._scan_prefetch is not None
+            and self._scan_prefetch[0] is self._scan_token
+        ):
+            return None
+        return self._scan_token
+
+    @staticmethod
+    def scan_transfer(token) -> Dict[int, np.ndarray]:
+        """The blocking device→host half of one scan consume — ``token``
+        holds immutable concrete device arrays, so an async server may
+        run THIS half (and only this half) off the serving thread, then
+        hand the result to :meth:`scan_prefetched`. This is the SAME
+        one-boxcar-stale transfer the pump would run inline, moved
+        off-loop — not an extra readback (the ticker adds zero new
+        transfers; the counting-shim test pins it)."""
+        return {
+            cap: np.array(dev)  # graftlint: readback(the pump's one-boxcar-stale health scan, run off-loop by the deadline ticker — the same single transfer per round, telemetry/README.md contract)
+            for cap, (dev, _gen) in token.items()
+        }
+
+    def scan_prefetched(self, token, host: Dict[int, np.ndarray]) -> None:
+        """Install an off-thread :meth:`scan_transfer` result: the next
+        scan consume uses it instead of blocking, IF the token is still
+        the in-flight one (a quiescence flush racing the ticker may have
+        consumed and replaced it — then the prefetch is simply dropped)."""
+        self._scan_prefetch = (token, host)
+
     def _consume_pending_scan(self, newly: List[ChannelKey]) -> None:
         """Consume the in-flight health scan, if any: the pump's one
         legal readback (one boxcar stale). Also closes the traced
@@ -771,7 +971,15 @@ class DeviceFleetBackend:
             return
         for t in self._trace_inflight:
             tracing.stamp(t, tracing.STAGE_SCAN_CONSUME, "start")
-        scans = self.fleet.finish_scan(self._scan_token)
+        host = None
+        if self._scan_prefetch is not None:
+            tok, pre = self._scan_prefetch
+            self._scan_prefetch = None
+            if tok is self._scan_token:
+                # The ticker already ran this token's blocking transfer
+                # off-loop; only the slot-generation masking runs here.
+                host = pre
+        scans = self.fleet.finish_scan(self._scan_token, host=host)
         self._scan_token = None
         now = time.perf_counter()
         if self._scan_dispatch_t is not None:
@@ -1033,5 +1241,7 @@ class DeviceFleetBackend:
             ring_staged=len(self._ring),
             pump_dispatches=self.pump_dispatches,
             pump_backpressure=self.pump_backpressure,
+            feed_size_triggers=self.feed_triggers["size"],
+            feed_deadline_triggers=self.feed_triggers["deadline"],
         )
         return s

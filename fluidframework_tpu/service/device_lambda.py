@@ -21,6 +21,12 @@ Crash recovery: this stage checkpoints no state — its durable form IS the
 deltas log (+ device-scribe summaries). A restarted consumer replays from
 offset zero and the backend's applied-seq watermarks make replay a no-op
 for anything already applied.
+
+Feeding cadence (r12): rows this stage enqueues no longer wait for
+pipeline quiescence — the pump sweep fires the backend's continuous-feed
+trigger (``DeviceFleetBackend.pump_feed``) after each ingest chunk, so a
+boxcar dispatches as soon as it fills or its feed deadline expires,
+exactly like the reference's free-running deli consumer.
 """
 
 from __future__ import annotations
@@ -50,9 +56,11 @@ class TpuDeliLambda(PartitionLambda):
             frame = value["frame"]
             traces = value.get("traces")
             if traces is not None:
-                # Sampled frame: the device span opens at enqueue; the
-                # backend closes it (and the commit span) at flush /
-                # scan-consume time.
+                # Sampled frame: the device span opens at enqueue (and
+                # track_trace opens the nested feed_wait span); the
+                # backend closes them as the continuous feed stages the
+                # boxcar (size/deadline trigger) and the scan consume
+                # lands — not at some later quiescence flush.
                 tracing.stamp(traces, tracing.STAGE_DEVICE, "start")
                 self.backend.track_trace(traces)
             self.backend.enqueue_frame(self.doc_id, frame)

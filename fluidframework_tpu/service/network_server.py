@@ -113,6 +113,13 @@ class FluidNetworkServer:
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
         self._server: Optional[asyncio.AbstractServer] = None
+        # The r12 deadline ticker: a loop task firing the device
+        # backend's continuous-feed trigger every feed-deadline period,
+        # so sub-threshold rows dispatch within the deadline even when
+        # no client read arrives. pump_ticks counts fired tick bodies
+        # (tests wait on it).
+        self._pump_task: Optional[asyncio.Task] = None
+        self.pump_ticks = 0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -131,6 +138,9 @@ class FluidNetworkServer:
                 self._handle, self.host, self.port
             )
             self.port = self._server.sockets[0].getsockname()[1]
+            dev = getattr(self.service, "device", None)
+            if dev is not None and getattr(dev, "pump_mode", False):
+                self._pump_task = asyncio.ensure_future(self._pump_ticker())
             self._started.set()
 
         self._loop.run_until_complete(boot())
@@ -144,6 +154,12 @@ class FluidNetworkServer:
             return
 
         async def shutdown():
+            if self._pump_task is not None:
+                self._pump_task.cancel()
+                try:
+                    await self._pump_task
+                except (asyncio.CancelledError, Exception):
+                    pass
             for s in list(self._sessions):
                 self._close_session(s)
             if self._server is not None:
@@ -350,6 +366,77 @@ class FluidNetworkServer:
                 scrape=backend._telemetry_finish(host, layout, totals)
             )
         return metrics.REGISTRY.render().encode()
+
+    async def _pump_ticker(self) -> None:
+        """The r12 deadline ticker (the continuous-feed analog of the
+        idle flush in ``_drain_all``): every feed-deadline period, fire
+        the backend's hybrid size/time trigger so sub-threshold rows
+        dispatch within ``feed_deadline_ms`` even when no client read
+        arrives — and barrier an idle in-flight health scan so capacity
+        nacks never wait for future traffic.
+
+        No device round trip ever lands on a submit path or the event
+        loop: the feed's Python-state halves (trigger check, staging,
+        the async AOT dispatch enqueue) run ON the loop, serialized with
+        the serving traffic, while the blocking scan consume runs
+        off-loop first (``scan_transfer`` → ``scan_prefetched``, the
+        same split as the /metrics readback) — the prefetch IS the
+        pump's one-boxcar-stale transfer, not an extra readback."""
+        loop = asyncio.get_running_loop()
+        while True:
+            # Re-fetch per tick: crash_device() REPLACES the service's
+            # backend, and a ticker pinned to the dead one would feed an
+            # orphan forever while the live backend misses its deadline.
+            dev = getattr(self.service, "device", None)
+            period = (
+                max(float(getattr(dev, "feed_deadline_ms", 3.0)), 0.5)
+                if dev is not None else 50.0
+            ) / 1e3
+            await asyncio.sleep(period)
+            if dev is None or not (
+                dev.needs_flush() or dev.needs_scan_drain()
+            ):
+                continue
+            self.pump_ticks += 1
+            try:
+                token = dev.prefetch_scan()
+                if token is not None:
+                    # Off-loop: the blocking device→host half of the
+                    # scan consume. The loop keeps serving while it
+                    # streams; the token-identity check in
+                    # scan_prefetched drops the result if a racing
+                    # drain consumed the scan first, and prefetch_scan
+                    # returns None while an installed prefetch awaits
+                    # its consume — the same token never transfers
+                    # twice.
+                    host = await loop.run_in_executor(
+                        None, dev.scan_transfer, token
+                    )
+                    dev.scan_prefetched(token, host)
+                if dev.needs_flush():
+                    # pump_feed_absorbed does the pump.feed recovery
+                    # accounting and absorbs the injected fault (a
+                    # faulted tick leaves the rows buffered; the next
+                    # tick re-fires over exactly those rows —
+                    # docs/failure-semantics.md).
+                    dev.pump_feed_absorbed()
+                elif dev.needs_scan_drain():
+                    # Idle with a scan still streaming: barrier it so
+                    # sticky errors surface without new traffic (the
+                    # prefetch above made this non-blocking).
+                    dev.collect_now()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # The ticker is a supervisor loop: a failed tick —
+                # including a failed off-loop transfer (e.g. the fleet
+                # torn down mid-stream by crash_device) — must not kill
+                # future ticks (the quiescence flush remains the
+                # correctness backstop).
+                continue
+            nack = getattr(self.service, "_nack_device_errors", None)
+            if nack is not None:
+                nack()
 
     def _authorized(self, params: dict, doc_id: str) -> bool:
         if self.tenants is None:
@@ -566,15 +653,15 @@ class FluidNetworkServer:
         if dev is not None:
             now = time.monotonic()
             last = getattr(self, "_last_dev_flush", 0.0)
-            if (dev._buffered_rows or len(dev._ring)) and now - last > 0.05:
+            if dev.needs_flush() and now - last > 0.05:
                 self._last_dev_flush = now
                 dev.flush()
                 nack = getattr(self.service, "_nack_device_errors", None)
                 if nack is not None:
                     nack()
             elif (
-                not dev._buffered_rows
-                and dev._scan_token is not None
+                not dev.needs_flush()
+                and dev.needs_scan_drain()
                 and now - last > 0.1
             ):
                 self._last_dev_flush = now

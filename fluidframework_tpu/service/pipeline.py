@@ -133,6 +133,7 @@ class PipelineFluidService:
         device_kernel: str = "auto",
         device_pump: bool = True,
         device_ring_depth: int = 2,
+        device_feed_deadline_ms: float = 3.0,
         foreman_tasks: tuple = ("summarizer",),
         index_sink: Optional[Any] = None,
         log: Optional[Any] = None,
@@ -233,12 +234,14 @@ class PipelineFluidService:
                 device_capacity, device_max_capacity,
                 device_sharded_overflow, device_max_batch, device_mesh,
                 device_kernel, device_pump, device_ring_depth,
+                device_feed_deadline_ms,
             )
 
     def _make_device(
         self, capacity: int, max_capacity: int, sharded_overflow: bool,
         max_batch: int = 512, mesh=None, kernel: str = "auto",
         pump: bool = True, ring_depth: int = 2,
+        feed_deadline_ms: float = 3.0,
     ) -> None:
         from fluidframework_tpu.service.device_backend import (
             DeviceFleetBackend,
@@ -248,15 +251,18 @@ class PipelineFluidService:
         # pump/ring_depth: the continuous device pump (r10) — flushes
         # ride the double-buffered ingest ring + AOT donated entries;
         # pump=False keeps the one-shot path (the parity reference).
+        # feed_deadline_ms: the r12 continuous front door — the hybrid
+        # size/time boxcar trigger the pump sweep and the network
+        # server's deadline ticker fire (DeviceFleetBackend.pump_feed).
         self.device = DeviceFleetBackend(
             capacity=capacity, max_capacity=max_capacity,
             sharded_overflow=sharded_overflow, max_batch=max_batch,
             mesh=mesh, kernel=kernel, pump_mode=pump,
-            ring_depth=ring_depth,
+            ring_depth=ring_depth, feed_deadline_ms=feed_deadline_ms,
         )
         self._device_capacity = (
             capacity, max_capacity, sharded_overflow, max_batch, mesh,
-            kernel, pump, ring_depth,
+            kernel, pump, ring_depth, feed_deadline_ms,
         )
 
         def factory(p: int, state):
@@ -334,7 +340,15 @@ class PipelineFluidService:
 
     def pump(self) -> int:
         """Run every stage until the whole pipeline is quiescent (the
-        in-proc analog of the async Kafka stages all catching up)."""
+        in-proc analog of the async Kafka stages all catching up).
+
+        The device stage is fed CONTINUOUSLY inside the sweep (r12):
+        after each tpu-deli ingest chunk, ``pump_feed`` stages any
+        boxcar that hit ``max_batch`` or outlived the feed deadline and
+        dispatches it while deli/scribe/scriptorium keep pumping — the
+        quiescence-time flush below survives only as the final drain +
+        err-surface barrier, and the one-shot path stays bit-exact
+        (feeds ride the same stage/dispatch machinery as flush)."""
         total = 0
         while True:
             n = (
@@ -345,7 +359,15 @@ class PipelineFluidService:
                 + self._signals.pump()
             )
             if self._device_runner is not None:
-                n += self._device_runner.pump()
+                nd = self._device_runner.pump()
+                n += nd
+                if nd and self.device is not None and self.device.pump_mode:
+                    # One continuous-feed tick WHILE the other stages
+                    # are still busy — the r12 front-door streaming.
+                    # Opportunistic: an injected tick fault is counted
+                    # and absorbed (pump_feed_absorbed); the quiescence
+                    # flush below is the correctness backstop.
+                    self.device.pump_feed_absorbed()
             if self._foreman is not None:
                 n += self._foreman.pump()
             if self._moira is not None:
@@ -367,19 +389,18 @@ class PipelineFluidService:
                 # asynchronously and its errors surface within one more
                 # pump — a per-pump synchronous readback would put the
                 # device round-trip latency on EVERY front-door submit.
-                if self.device is not None and (
-                    self.device._buffered_rows >= self.device_flush_min_rows
-                    or self.device._unreported
-                    # A crash at the dispatch boundary can requeue a
-                    # staged ring slot with nothing left buffered; the
-                    # drain contract must not depend on future traffic.
-                    or len(self.device._ring)
+                if self.device is not None and self.device.needs_flush(
+                    self.device_flush_min_rows
                 ):
+                    # needs_flush covers buffered rows at/above the
+                    # threshold, unreported err channels, AND ring slots
+                    # requeued by a dispatch crash — the drain contract
+                    # must not depend on future traffic.
                     self.device.flush()
                     self._nack_device_errors()
                 elif (
                     self.device is not None
-                    and self.device._scan_token is not None
+                    and self.device.needs_scan_drain()
                 ):
                     # No new rows, but the LAST boxcar's health scan is
                     # still streaming: drain it so its capacity errors

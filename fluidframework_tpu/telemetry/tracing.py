@@ -31,6 +31,11 @@ from typing import Dict, List, Optional
 #   device_step   the AOT donated dispatch call (enqueue cost, not
 #                 device compute — the number the pump drives to ~0)
 #   scan_consume  the one-boxcar-stale health-scan readback wait
+# The continuous front door (r12) adds one more nested stage:
+#   feed_wait     device-stage enqueue -> the feed trigger (boxcar full
+#                 or feed_deadline_ms expired) stages the row's boxcar —
+#                 the time a row sat buffered waiting for its boxcar to
+#                 form; bounded by the deadline under continuous feeding
 STAGE_ALFRED = "alfred"
 STAGE_DELI = "deli"
 STAGE_SCRIPTORIUM = "scriptorium"
@@ -40,6 +45,7 @@ STAGE_BROADCAST = "broadcast"
 STAGE_RING_STAGE = "ring_stage"
 STAGE_DEVICE_STEP = "device_step"
 STAGE_SCAN_CONSUME = "scan_consume"
+STAGE_FEED_WAIT = "feed_wait"
 FRAME_STAGES = (
     STAGE_ALFRED,
     STAGE_DELI,
@@ -50,6 +56,7 @@ FRAME_STAGES = (
     STAGE_RING_STAGE,
     STAGE_DEVICE_STEP,
     STAGE_SCAN_CONSUME,
+    STAGE_FEED_WAIT,
 )
 
 

@@ -63,6 +63,13 @@ SITES: Dict[str, str] = {
     # buffers/ring consistent either side of the boundary; pump_drain()
     # replays everything staged with no lost/dup ops.
     "pump.stage": "drain",
+    # Continuous-feed trigger (DeviceFleetBackend.pump_feed — the hybrid
+    # size/deadline boxcar trigger the r12 front door rides): a crashed
+    # deadline tick leaves every row buffered (crash-before/fail) or the
+    # feed complete (crash-after); the next tick — or the quiescence
+    # flush / pump_drain — re-fires over exactly the buffered rows, so
+    # nothing is lost and the stage-time watermarks prevent duplicates.
+    "pump.feed": "drain",
     # Device dispatch (the AOT donated dispatch inside _dispatch_one):
     # failure falls back to the one-shot host-staged apply path from the
     # slot's retained host copy — never silent; a crash BEFORE the

@@ -199,6 +199,55 @@ def test_r10_exempt_from_fault_recovery_key(tmp_path):
     assert cba.check(str(tmp_path)) == 0
 
 
+def test_r12_requires_frontdoor_keys(tmp_path):
+    """An r12+ artifact must carry the continuous-front-door pair — the
+    parity-pinned streaming-feed throughput AND the submit→device-commit
+    feed latency under continuous feed."""
+    cba = _tool()
+    prior = {
+        "pipeline_serving_ops_per_sec": 2,
+        "deli_scribe_e2e_ops_per_sec": 3,
+        "fleet_mesh_ops_per_sec": 4,
+        "tree_moves_device_fraction": 0.97,
+        "serving_stage_spans_ms": {"deli": 0.2, "total": 4.5},
+        "device_shard_occupancy": {"128": [5, 5, 5, 5]},
+        "serving_pump_ops_per_sec": 123456,
+        "serving_pump_device_idle_frac": 0.12,
+        "fault_recovery_ops_per_sec": 54321,
+    }
+    _write(tmp_path, "BENCH_r12.json", [json.dumps(prior)])
+    assert cba.check(str(tmp_path)) == 1
+    # One of the pair is not enough.
+    _write(tmp_path, "BENCH_r12.json", [json.dumps(dict(
+        prior, serving_frontdoor_ops_per_sec=222222,
+    ))])
+    assert cba.check(str(tmp_path)) == 1
+    _write(tmp_path, "BENCH_r12.json", [json.dumps(dict(
+        prior,
+        serving_frontdoor_ops_per_sec=222222,
+        serving_feed_latency_ms=1.7,
+    ))])
+    assert cba.check(str(tmp_path)) == 0
+
+
+def test_r11_exempt_from_frontdoor_keys(tmp_path):
+    """Per-key since-round gating: an r11 artifact predates the
+    front-door pair and passes with the nine prior keys."""
+    cba = _tool()
+    _write(tmp_path, "BENCH_r11.json", [json.dumps({
+        "pipeline_serving_ops_per_sec": 2,
+        "deli_scribe_e2e_ops_per_sec": 3,
+        "fleet_mesh_ops_per_sec": 4,
+        "tree_moves_device_fraction": 0.97,
+        "serving_stage_spans_ms": {"deli": 0.2, "total": 4.5},
+        "device_shard_occupancy": {"128": [5, 5, 5, 5]},
+        "serving_pump_ops_per_sec": 123456,
+        "serving_pump_device_idle_frac": 0.12,
+        "fault_recovery_ops_per_sec": 54321,
+    })])
+    assert cba.check(str(tmp_path)) == 0
+
+
 def test_newest_round_governs(tmp_path):
     cba = _tool()
     _write(tmp_path, "BENCH_r05.json", ['{"metric": "old"}'])

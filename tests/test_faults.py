@@ -2,7 +2,7 @@
 
 The r11 robustness contract (docs/failure-semantics.md): with a fault
 injected at any stage boundary the trace spine names — store append, queue
-send, pump stage/dispatch, websocket delivery, lease acquire/renew — the
+send, pump stage/feed/dispatch, websocket delivery, lease acquire/renew — the
 pipeline's wired recovery (retry / fallback / requeue / drain / fence)
 must reproduce the un-faulted run BIT-IDENTICALLY: same device text, same
 device lane state, same sequenced-op identity list, zero lost and zero
@@ -258,7 +258,10 @@ def _policy(kind: str) -> faults.FaultPolicy:
 
 MATRIX = [
     (site, kind)
-    for site in ("store.append", "queue.send", "pump.stage", "pump.dispatch")
+    for site in (
+        "store.append", "queue.send", "pump.stage", "pump.feed",
+        "pump.dispatch",
+    )
     for kind in ("fail", "crash_before", "crash_after")
 ]
 
@@ -416,6 +419,53 @@ class TestPumpChaos:
         faults.disarm()
         be.pump_drain()
         assert be.stats()["ops_applied"] == N_CH * K
+        _pool_parity(be, self._reference(1))
+
+    def test_feed_tick_crash_leaves_rows_buffered_next_tick_refires(self):
+        """The r12 ``pump.feed`` recovery contract: a crashed deadline
+        tick leaves every row buffered, the crash is counted (requeue,
+        never silent), and the NEXT tick re-fires over exactly those
+        rows — no op lost, none duplicated, state bit-identical to an
+        unfaulted run."""
+        be = DeviceFleetBackend(
+            capacity=128, max_batch=1 << 20, pump_mode=True,
+            ring_depth=1, feed_deadline_ms=0.0,
+        )
+        _feed_backend(be, 0)
+        pre_rq = _recovery_total("pump.feed", "requeue")
+        faults.arm("pump.feed", faults.CrashAt("before"))
+        with pytest.raises(faults.InjectedCrash):
+            be.pump_feed_counted()
+        faults.disarm()
+        assert be.stats()["ops_applied"] == 0
+        assert be.needs_flush(), "crashed tick must leave rows buffered"
+        assert _recovery_total("pump.feed", "requeue") == pre_rq + 1
+        be.pump_feed_counted()  # the next tick re-fires
+        be.pump_drain()
+        stats = be.stats()
+        assert stats["ops_applied"] == N_CH * K
+        assert stats["docs_with_errors"] == 0
+        _pool_parity(be, self._reference(1))
+
+    def test_feed_tick_crash_after_is_fatal_not_refired(self):
+        """Crash AFTER the feed ran: the boxcar dispatched and only the
+        ack was lost — counted fatal, nothing re-fires, and redelivered
+        rows drop at the watermarks (no double-apply)."""
+        be = DeviceFleetBackend(
+            capacity=128, max_batch=1 << 20, pump_mode=True,
+            ring_depth=1, feed_deadline_ms=0.0,
+        )
+        _feed_backend(be, 0)
+        pre_ft = _recovery_total("pump.feed", "fatal")
+        faults.arm("pump.feed", faults.CrashAt("after"))
+        with pytest.raises(faults.InjectedCrash):
+            be.pump_feed_counted()
+        faults.disarm()
+        assert _recovery_total("pump.feed", "fatal") == pre_ft + 1
+        _feed_backend(be, 0)  # at-least-once redelivery of the same round
+        be.pump_feed_counted()
+        be.pump_drain()
+        assert be.stats()["ops_applied"] == N_CH * K  # no dup
         _pool_parity(be, self._reference(1))
 
 

@@ -206,6 +206,44 @@ def test_host_sync_pump_scan_consume_readback_pragma(tmp_path):
     assert annotated == []
 
 
+def test_host_sync_ticker_scan_prefetch_readback_pragma(tmp_path):
+    """The r12 deadline ticker's off-loop prefetch shape: the blocking
+    half of the scan consume (np.array over the token's device arrays)
+    moved off the event loop. It is the SAME one-boxcar-stale transfer
+    the pump would run inline — the ticker performs ZERO new readbacks —
+    so the np.array is flagged bare and suppressed only by the reasoned
+    pragma the production ``scan_transfer`` carries."""
+    _, HostSync, *_ = _tools()
+    snippet = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def _pool_scan(state):
+        return jnp.stack([state.count, state.err])
+
+    def tick_prefetch(pool):
+        # the deadline ticker's off-loop half: transfer the in-flight
+        # scan token's device snapshot (run_in_executor), so the on-loop
+        # feed consumes it without blocking
+        dev = _pool_scan(pool.state)  # the token's async snapshot
+        return np.array(dev){pragma}
+    """
+    bare = _run_pass(HostSync, snippet.format(pragma=""), tmp_path)
+    assert len(bare) == 1 and "device→host" in bare[0].message
+    annotated = _run_pass(
+        HostSync,
+        snippet.format(
+            pragma="  # graftlint: readback(the pump's one-boxcar-stale"
+            " health scan, run off-loop by the deadline ticker — the"
+            " same single transfer per round, zero new readbacks)"
+        ),
+        tmp_path,
+    )
+    assert annotated == []
+
+
 # -- recompile-hazard ----------------------------------------------------------
 
 
@@ -550,10 +588,37 @@ def test_fault_site_accepts_documented_vocabulary(tmp_path):
         @inject_fault("pump.dispatch")
         def dispatch(fleet, docs, rows):
             fleet.dispatch_staged(docs, rows)
+
+        @inject_fault("pump.feed")
+        def feed(backend):
+            backend.pump_stage()
+            return backend.pump_dispatch()
         """,
         tmp_path,
     )
     assert findings == []
+
+
+def test_fault_site_flags_unregistered_feed_site(tmp_path):
+    """The r12 regression shape: a continuous-feed boundary added to a
+    production module without declaring it in the vocabulary (e.g. a
+    second ticker trigger named off-vocabulary) must fail lint — the
+    deadline tick's recovery contract (rows stay buffered, next tick
+    re-fires) only exists if the site is documented."""
+    findings = _run_pass(
+        _fault_site_pass(),
+        """
+        from fluidframework_tpu.testing.faults import inject_fault
+
+        @inject_fault("pump.feed_tick")
+        def feed_tick(backend):
+            return backend.pump_dispatch()
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "unknown injection site" in findings[0].message
+    assert "pump.feed_tick" in findings[0].message
 
 
 def test_fault_site_flags_unregistered_recovery(tmp_path):

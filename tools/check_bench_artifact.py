@@ -31,7 +31,10 @@ from typing import List, Tuple
 # telemetry scrape — in r9; the continuous-pump pair — parity-pinned pump
 # throughput and the measured device idle fraction — in r10; the
 # chaos-recovery headline — serving throughput under the standard 1%
-# fault mix, parity-asserted — in r11.
+# fault mix, parity-asserted — in r11; the continuous-front-door pair —
+# streaming-feed throughput (parity-pinned against the quiescence-gated
+# flush path on dense + mesh lanes) and the submit→device-commit feed
+# latency under continuous feed — in r12.
 REQUIRED = (
     ("pipeline_serving_ops_per_sec", 6),
     ("deli_scribe_e2e_ops_per_sec", 6),
@@ -42,6 +45,8 @@ REQUIRED = (
     ("serving_pump_ops_per_sec", 10),
     ("serving_pump_device_idle_frac", 10),
     ("fault_recovery_ops_per_sec", 11),
+    ("serving_frontdoor_ops_per_sec", 12),
+    ("serving_feed_latency_ms", 12),
 )
 # Artifacts up to round 5 predate every gated metric.
 BASELINE_ROUND = 5
