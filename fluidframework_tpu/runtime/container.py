@@ -12,12 +12,14 @@ against pending state to drive the ack path.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Any, Callable, Dict, Optional
 
 from fluidframework_tpu.protocol.types import (
     DocumentMessage,
     MessageType,
+    NackErrorType,
     SequencedDocumentMessage,
 )
 from fluidframework_tpu.runtime.gc import GarbageCollector, GCOptions, GCResult
@@ -106,6 +108,14 @@ class ContainerRuntime:
         self.approved_proposals: Dict[str, Any] = {}
         self.on_op: Optional[Callable[[SequencedDocumentMessage], None]] = None
         self._op_listeners: list = []  # multi-subscriber op tap (helpers)
+        # Throttling-nack pacing (r13, the admission-control client half):
+        # a 429 ThrottlingError nack carries retry_after_s, and resubmitting
+        # before it elapses just earns the same nack again — so the nack
+        # loop SLEEPS the retry-after through this cooperative hook before
+        # regenerating (tests install a virtual clock; production keeps
+        # time.sleep). throttle_waits counts paces for tests/telemetry.
+        self.throttle_sleep: Callable[[float], None] = time.sleep
+        self.throttle_waits = 0
         # Summary tracking (reference SummaryCollection / RunningSummarizer).
         self.last_summary_seq = 0
         self.summary_interval: Optional[int] = None  # auto-summarize period
@@ -416,9 +426,37 @@ class ContainerRuntime:
         # nothing from this connection sequences until we resend, so the
         # entire pending tail regenerates against the caught-up state.
         guard = 0
+        throttle_guard = 0
         while self.connection.nacks and self.connected:
-            guard += 1
-            assert guard < 8, "nack resubmission did not converge"
+            # Admission throttling (429 ThrottlingError + retry_after_s):
+            # a PACED resubmission, not a convergence failure — honor the
+            # server's retry-after through the cooperative sleep hook so
+            # the token bucket refills, and track it on its own (much
+            # wider) guard instead of burning the spin guard below. Mixed
+            # batches (a throttle nack alongside a real rejection) take
+            # the spin guard: the non-throttle nack is the one that must
+            # converge.
+            throttles = [
+                n for n in self.connection.nacks
+                if getattr(n, "error_type", None) == NackErrorType.THROTTLING
+                and getattr(n, "retry_after_s", 0.0) > 0.0
+            ]
+            if throttles and len(throttles) == len(self.connection.nacks):
+                throttle_guard += 1
+                if throttle_guard >= 64:
+                    # Sustained server-side throttling (e.g. a long
+                    # REFUSE_CONNECTIONS episode): yield back to the
+                    # caller with pending INTACT instead of crashing a
+                    # correctly-paced client — the next
+                    # process_incoming resumes pacing where this one
+                    # left off, and the ops resubmit once the envelope
+                    # opens.
+                    break
+                self.throttle_waits += 1
+                self.throttle_sleep(max(n.retry_after_s for n in throttles))
+            else:
+                guard += 1
+                assert guard < 8, "nack resubmission did not converge"
             if any(
                 getattr(n, "content_code", 0) >= 500
                 for n in self.connection.nacks

@@ -908,6 +908,28 @@ class DeviceFleetBackend:
         except faults.InjectedFault:
             return []
 
+    def pressure(self) -> "PressureSignal":
+        """The typed backpressure signal (r13): ring occupancy, queue
+        depth, and feed latency as one :class:`admission.PressureSignal`
+        the overload envelope consumes — the pipeline's pump sweep, the
+        network server's deadline ticker, and (through the tier it
+        drives) the asyncio accept loop. Ring-full pressure used to be
+        relieved ONLY by oldest-dispatches-first inside the pump; this
+        surfaces it so admission throttles and the accept loop pauses
+        before the in-process queues grow unbounded. Pure host state —
+        no device round trip."""
+        from fluidframework_tpu.service.admission import PressureSignal
+
+        lag_ms = 0.0
+        if self._feed_edge is not None and self._buffered_rows:
+            lag_ms = (time.perf_counter() - self._feed_edge) * 1e3
+        return PressureSignal(
+            ring_frac=len(self._ring) / self._ring.depth,
+            queue_frac=self._buffered_rows / max(1, self.max_batch),
+            feed_lag_ms=lag_ms,
+            scan_inflight=self._scan_token is not None,
+        )
+
     def needs_flush(self, min_rows: int = 1) -> bool:
         """True when a flush would do work: buffered rows at/above
         ``min_rows``, staged ring slots (possibly requeued by a crash —

@@ -36,7 +36,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
-from fluidframework_tpu.service import retry, wsproto
+from fluidframework_tpu.service import admission, retry, wsproto
 from fluidframework_tpu.service.codec import from_jsonable, to_jsonable
 from fluidframework_tpu.service.local_server import LocalFluidService
 from fluidframework_tpu.telemetry import metrics
@@ -120,6 +120,17 @@ class FluidNetworkServer:
         # (tests wait on it).
         self._pump_task: Optional[asyncio.Task] = None
         self.pump_ticks = 0
+        # The overload envelope (r13): the REFUSE_CONNECTIONS tier gates
+        # the accept path (a refused socket gets a 503 + Retry-After
+        # right after the bounded header read and holds ZERO session
+        # state — the pause-accept analog: back pressure reaches the
+        # socket edge instead of growing in-process queues; GET /metrics
+        # alone is exempt so the scaler can still see tier 3), and
+        # SHED_READS sheds REST reads and push subscriptions. Counters
+        # are the test/bench view; the metric families are the
+        # scaler's.
+        self.connections_refused = 0
+        self.reads_shed = 0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -190,6 +201,29 @@ class FluidNetworkServer:
                     return
             request_line, headers, rest = head
             method, path, _ = request_line.decode().split(" ", 2)
+            # REFUSE_CONNECTIONS (the LAST shed tier): turn the new
+            # socket away right after the bounded header read — no
+            # session allocation, no websocket handshake, nothing queued
+            # in-process — with ONE exemption: GET /metrics. The scaler
+            # reads its scale-up signal there precisely when the
+            # envelope is at its worst; refusing the scrape would pin
+            # the server at tier 3 with no one able to see it.
+            ov = getattr(self.service, "overload", None)
+            if ov is not None and ov.refuse_connections() and not (
+                method == "GET" and urlparse(path).path == "/metrics"
+            ):
+                self.connections_refused += 1
+                admission.shed_counter().inc(kind="connection")
+                retry_after_s = max(1, int(ov.retry_after_ms() / 1e3 + 0.5))
+                writer.write(
+                    (
+                        "HTTP/1.1 503 Service Unavailable\r\n"
+                        f"Retry-After: {retry_after_s}\r\n"
+                        "Content-Length: 0\r\nConnection: close\r\n\r\n"
+                    ).encode()
+                )
+                await writer.drain()
+                return
             if headers.get("upgrade", "").lower() == "websocket":
                 await self._websocket(reader, writer, headers, rest)
             else:
@@ -225,12 +259,17 @@ class FluidNetworkServer:
         parts = [p for p in url.path.split("/") if p]
         query = {k: v[0] for k, v in parse_qs(url.query).items()}
 
-        def reply(status: int, payload: bytes = b"", ctype="application/json"):
+        def reply(status: int, payload: bytes = b"", ctype="application/json",
+                  headers: Optional[dict] = None):
+            extra = "".join(
+                f"{k}: {v}\r\n" for k, v in (headers or {}).items()
+            )
             writer.write(
                 (
                     f"HTTP/1.1 {status} X\r\n"
                     f"Content-Type: {ctype}\r\n"
                     f"Content-Length: {len(payload)}\r\n"
+                    f"{extra}"
                     "Connection: close\r\n\r\n"
                 ).encode()
                 + payload
@@ -240,9 +279,33 @@ class FluidNetworkServer:
             # Prometheus exposition (unauthenticated, like the health
             # surface): refresh the device gauges with the contractual
             # ONE batched readback, then render the process registry.
+            # NEVER shed — the scaler reads its signal here precisely
+            # when the envelope is under pressure.
             reply(
                 200, await self._metrics_payload(),
                 ctype="text/plain; version=0.0.4; charset=utf-8",
+            )
+            await writer.drain()
+            return
+        # SHED_READS (the FIRST shed tier): every REST read — deltas,
+        # document metadata, device-served channel snapshots, blob
+        # fetches — sheds with a 503 + Retry-After before touching the
+        # service, so the sequencing path keeps its budget for writes.
+        # Writes (POST /blobs, POST /documents) pass: their throttling
+        # is admission's (nack + retry-after), one tier later.
+        ov = getattr(self.service, "overload", None)
+        if (
+            ov is not None and ov.shed_reads() and method in ("GET", "HEAD")
+        ):
+            self.reads_shed += 1
+            admission.shed_counter().inc(kind="read")
+            reply(
+                503, b'{"error": "overloaded, reads shed"}',
+                headers={
+                    "Retry-After": max(
+                        1, int(ov.retry_after_ms() / 1e3 + 0.5)
+                    ),
+                },
             )
             await writer.drain()
             return
@@ -393,6 +456,27 @@ class FluidNetworkServer:
                 if dev is not None else 50.0
             ) / 1e3
             await asyncio.sleep(period)
+            # Backpressure propagation (r13): every tick — including
+            # idle ones, so the tier can step DOWN as pressure clears —
+            # feeds the device's typed pressure signal into the overload
+            # controller and lets admission retarget its refill rates
+            # from the registry's live applied-ops rate. Pure host
+            # state, no device round trip on the loop.
+            ov = getattr(self.service, "overload", None)
+            if dev is not None and ov is not None:
+                ov.observe(dev.pressure())
+            adm = getattr(self.service, "admission", None)
+            if adm is not None:
+                # Feed the LIVE host counter (dev.ops_applied advances
+                # with every boxcar), not the scrape-refreshed gauge —
+                # a fast ticker on the gauge reads delta=0 between
+                # Prometheus scrapes and would pin the rates to the
+                # autotune floor.
+                adm.autotune(
+                    applied_total=(
+                        dev.ops_applied if dev is not None else None
+                    )
+                )
             if dev is None or not (
                 dev.needs_flush() or dev.needs_scan_drain()
             ):
@@ -586,9 +670,20 @@ class FluidNetworkServer:
                                      "error": "invalid token"})
                 return
             try:
-                conn = self.service.connect(
-                    doc_id, msg.get("mode", "write"), msg.get("from_seq", 0)
-                )
+                if msg.get("tenant") and hasattr(self.service, "admission"):
+                    # Scope the admission budget to the authenticated
+                    # tenant (riddler): per-tenant token buckets give
+                    # overload FAIRNESS — one tenant's burst throttles
+                    # that tenant, not the fleet.
+                    conn = self.service.connect(
+                        doc_id, msg.get("mode", "write"),
+                        msg.get("from_seq", 0), tenant=msg["tenant"],
+                    )
+                else:
+                    conn = self.service.connect(
+                        doc_id, msg.get("mode", "write"),
+                        msg.get("from_seq", 0),
+                    )
             except ConnectionError as e:
                 self._send(session, {"type": "connect_document_error",
                                      "error": str(e)})
@@ -609,6 +704,20 @@ class FluidNetworkServer:
                 },
             )
         elif t == "subscribe_push":
+            ov = getattr(self.service, "overload", None)
+            if ov is not None and ov.shed_reads():
+                # Push subscriptions are delivery-only READ load: shed
+                # them with a retry-after at the first tier, like the
+                # REST reads (the op channel's writes throttle one tier
+                # later, through admission).
+                self.reads_shed += 1
+                admission.shed_counter().inc(kind="subscribe")
+                self._send(session, {
+                    "type": "subscribe_push_error",
+                    "error": "overloaded, reads shed",
+                    "retry_after_ms": ov.retry_after_ms(),
+                })
+                return
             if session.conn is not None or session.push_doc is not None:
                 # One role per socket, once: a combined session would
                 # starve its op-channel queue in _drain_all, and a repeat

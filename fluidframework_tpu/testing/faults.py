@@ -87,6 +87,19 @@ SITES: Dict[str, str] = {
     # renew loses the document; the epoch fence rejects its in-flight
     # writes and the multinode submit path reroutes to the new owner.
     "lease.renew": "fence",
+    # Admission check (AdmissionController.decide — the r13 overload
+    # front door): a crashed or failed check FAILS CLOSED — the op is
+    # denied and nacked with ThrottlingError + retry_after, NEVER
+    # silently admitted (an unaccounted admit under overload is the
+    # cliff the envelope exists to prevent); the client's nack-resubmit
+    # loop re-offers the op after the retry-after pace.
+    "admission.decide": "nack",
+    # Load-shed tier evaluation (OverloadController.observe — the r13
+    # tiered shedding controller): a crashed evaluation HOLDS the last
+    # known tier (fail-static: a blip must not flap the envelope open or
+    # slam it shut); the next observation re-evaluates from live
+    # pressure.
+    "shed.tier": "fallback",
 }
 
 #: The recovery kinds the contract table documents. A site mapped to

@@ -62,6 +62,15 @@ class LoadProfile:
     chaos_rate: float = 0.0
     chaos_sites: tuple = ("store.append", "queue.send", "pump.dispatch")
     chaos_seed: int = 0
+    # Full client stack under chaos (r13, the carried CHAOS_STRESS
+    # remainder): every runtime gets the auto-summarize interval (the
+    # quorum-elected client actually summarizes, the reference
+    # SummaryManager shape) and the acting client runs a GC pass every
+    # ``gc_every`` global steps — so summaries, GC sweeps, and (with the
+    # service's default foreman) service task assignment all ride the
+    # faulted pipeline, not just raw op traffic.
+    summary_interval: Optional[int] = None
+    gc_every: int = 0
 
 
 @dataclass
@@ -79,6 +88,11 @@ class LoadReport:
     tree_ops_submitted: int = 0
     tree_moves_submitted: int = 0
     trees: list = field(default_factory=list)  # per-replica tree views
+    summaries: int = 0  # summarize ops sequenced during the run
+    gc_runs: int = 0
+    # tree_ingest_commits_total{path,reason} DELTA over the run — the
+    # host_fallback_reason burn-down view (STATUS.md baseline).
+    tree_ingest: dict = field(default_factory=dict)
 
     @property
     def ops_per_sec(self) -> float:
@@ -102,6 +116,16 @@ CHAOS_STRESS_PROFILE = LoadProfile(
 CHAOS_REFERENCE_PROFILE = LoadProfile(
     n_clients=120, total_ops=10_000, seed=23, fault_rate=0.005,
     offline_ops=60, chaos_rate=0.01, doc_id="chaos-reference",
+)
+# The carried CHAOS_STRESS remainder (r13): the stress shape with the
+# FULL client stack active — tree traffic (move-bearing, so the device
+# EM path and its host_fallback_reason buckets are exercised), the
+# elected summarizer, periodic GC, and the service-side foreman (on by
+# default in PipelineFluidService) — all under the standard chaos mix.
+CHAOS_STRESS_FULL_PROFILE = LoadProfile(
+    n_clients=48, total_ops=3000, seed=17, fault_rate=0.005,
+    offline_ops=40, chaos_rate=0.01, doc_id="chaos-stress-full",
+    tree_weight=0.25, summary_interval=150, gc_every=300,
 )
 
 
@@ -152,6 +176,23 @@ class LoadRunner:
         ]
         for rt in runtimes:
             rt.on_nack_count = 0
+            if p.summary_interval:
+                # Every client is summarize-eligible; the quorum
+                # election picks the actual summarizer (oldest writer),
+                # exactly the reference SummaryManager shape.
+                rt.summary_interval = p.summary_interval
+        from fluidframework_tpu.telemetry import metrics as _metrics
+
+        def _ingest_buckets() -> dict:
+            c = _metrics.REGISTRY.get("tree_ingest_commits_total")
+            if c is None:
+                return {}
+            return {
+                f"{dict(k)['path']}:{dict(k)['reason']}": v
+                for k, _s, v in c.samples()
+            }
+
+        pre_ingest = _ingest_buckets()
         offline_until: dict = {}  # runtime index -> step to reconnect at
 
         def one_tree_op(rt: ContainerRuntime) -> None:
@@ -223,6 +264,21 @@ class LoadRunner:
                 continue
             if online and step % p.flush_every == 0:
                 rt.flush()
+            if (
+                p.gc_every and online and step
+                and step % p.gc_every == 0
+            ):
+                # Periodic GC on the acting client: the sweep rides the
+                # same faulted pipeline as the op traffic. GC summarizes
+                # every channel, so it needs a locally-quiesced client
+                # (the same bar the auto-summarizer applies) — settle
+                # first and skip if in-flight state survives the drain.
+                rt.flush()
+                self._settle(runtimes, offline_until)
+                rt.process_incoming()
+                if not rt._has_unacked_local_state():
+                    rt.run_gc()
+                    report.gc_runs += 1
             if step % p.process_every == 0:
                 self._settle(runtimes, offline_until)
 
@@ -269,6 +325,21 @@ class LoadRunner:
         )
         report.final_text_len = len(texts[0])
         report.nacks = sum(len(rt.connection.nacks) for rt in runtimes)
+        post_ingest = _ingest_buckets()
+        report.tree_ingest = {
+            k: int(v - pre_ingest.get(k, 0))
+            for k, v in post_ingest.items()
+            if v - pre_ingest.get(k, 0) > 0
+        }
+        if p.summary_interval:
+            from fluidframework_tpu.protocol.types import MessageType
+
+            get_deltas = getattr(self.service, "get_deltas", None)
+            if get_deltas is not None:
+                report.summaries = sum(
+                    1 for m in get_deltas(p.doc_id)
+                    if m.type == MessageType.SUMMARIZE
+                )
         report.elapsed_s = time.monotonic() - t0
         for rt in runtimes:
             if rt.connected:

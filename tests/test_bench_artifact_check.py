@@ -248,6 +248,50 @@ def test_r11_exempt_from_frontdoor_keys(tmp_path):
     assert cba.check(str(tmp_path)) == 0
 
 
+_R12_COMPLETE = {
+    "pipeline_serving_ops_per_sec": 2,
+    "deli_scribe_e2e_ops_per_sec": 3,
+    "fleet_mesh_ops_per_sec": 4,
+    "tree_moves_device_fraction": 0.97,
+    "serving_stage_spans_ms": {"deli": 0.2, "total": 4.5},
+    "device_shard_occupancy": {"128": [5, 5, 5, 5]},
+    "serving_pump_ops_per_sec": 123456,
+    "serving_pump_device_idle_frac": 0.12,
+    "fault_recovery_ops_per_sec": 54321,
+    "serving_frontdoor_ops_per_sec": 222222,
+    "serving_feed_latency_ms": 1.7,
+}
+
+
+def test_r13_requires_overload_keys(tmp_path):
+    """An r13+ artifact must carry the overload-envelope pair — the
+    0.5x/1x/2x goodput curve (linear-not-cliff) AND the counted
+    load-shedding tier transitions."""
+    cba = _tool()
+    _write(tmp_path, "BENCH_r13.json", [json.dumps(_R12_COMPLETE)])
+    assert cba.check(str(tmp_path)) == 1
+    # One of the pair is not enough.
+    _write(tmp_path, "BENCH_r13.json", [json.dumps(dict(
+        _R12_COMPLETE,
+        overload_goodput_curve={"0.5x": 8.0, "1x": 16.0, "2x": 15.5},
+    ))])
+    assert cba.check(str(tmp_path)) == 1
+    _write(tmp_path, "BENCH_r13.json", [json.dumps(dict(
+        _R12_COMPLETE,
+        overload_goodput_curve={"0.5x": 8.0, "1x": 16.0, "2x": 15.5},
+        serving_overload_tier_transitions={"NORMAL->SHED_READS": 1},
+    ))])
+    assert cba.check(str(tmp_path)) == 0
+
+
+def test_r12_exempt_from_overload_keys(tmp_path):
+    """Per-key since-round gating: an r12 artifact predates the overload
+    pair and passes with the eleven prior keys."""
+    cba = _tool()
+    _write(tmp_path, "BENCH_r12.json", [json.dumps(_R12_COMPLETE)])
+    assert cba.check(str(tmp_path)) == 0
+
+
 def test_newest_round_governs(tmp_path):
     cba = _tool()
     _write(tmp_path, "BENCH_r05.json", ['{"metric": "old"}'])

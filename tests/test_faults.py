@@ -30,6 +30,7 @@ from fluidframework_tpu.protocol.opframe import OpFrame, SeqFrame
 from fluidframework_tpu.protocol.types import (
     DocumentMessage,
     MessageType,
+    NackErrorType,
     NackMessage,
 )
 from fluidframework_tpu.service.device_backend import DeviceFleetBackend
@@ -178,13 +179,24 @@ def _submit(conn, frame):
     """Submit with the documented crash recovery: the harness plays the
     restart supervisor / reconnecting client — resubmitting the SAME
     frame after an injected fault is the real client behavior, and csn
-    dedup at deli absorbs whatever half-landed."""
+    dedup at deli absorbs whatever half-landed. An admission throttle
+    (r13: the frame was DENIED ahead of sequencing and nacked, nothing
+    half-landed) resubmits the same way — the nack-recovery client
+    contract."""
     for _ in range(8):
         try:
             conn.submit_frame(frame)
-            return
         except faults.InjectedFault:
             continue
+        if conn.nacks:
+            throttles = [
+                n for n in conn.nacks
+                if n.error_type == NackErrorType.THROTTLING
+            ]
+            assert len(throttles) == len(conn.nacks), conn.nacks
+            conn.nacks.clear()
+            continue
+        return
     raise AssertionError("fault policy did not clear within 8 resubmits")
 
 
@@ -261,6 +273,12 @@ MATRIX = [
     for site in (
         "store.append", "queue.send", "pump.stage", "pump.feed",
         "pump.dispatch",
+        # r13, the overload envelope: a faulted admission check fails
+        # CLOSED (the op is nacked and the client resubmits — never
+        # silently admitted, never dropped), and a faulted tier
+        # evaluation holds the last tier — both must reproduce the
+        # un-faulted run bit-identically.
+        "admission.decide", "shed.tier",
     )
     for kind in ("fail", "crash_before", "crash_after")
 ]
@@ -297,6 +315,35 @@ class TestChaosMatrix:
         state = _run_chaos_workload(arm=arm)
         assert state == ref
         assert faults.REGISTRY.injected_total() > 0
+
+    def test_crashed_admission_check_fails_closed_with_nack(self):
+        """The r13 overload row, spelled out: a CRASHED admission check
+        — even crash-after, where the inner decision computed and only
+        the ack was lost — denies and NACKS with ThrottlingError +
+        retry_after; the op is never silently admitted and never
+        dropped (the resubmit sequences it exactly once)."""
+        svc = PipelineFluidService(n_partitions=2)
+        conn = svc.connect("fc-doc")
+        head = svc.doc_head("fc-doc")
+        frame = OpFrame.build(
+            "s", ["ins"], [0], [conn.conn_no * MINT + 1], ["x"],
+            csn0=1, ref=head,
+        )
+        faults.arm("admission.decide", faults.CrashAt("after"))
+        conn.submit_frame(frame)
+        faults.disarm()
+        assert svc.doc_head("fc-doc") == head, "silently admitted"
+        assert conn.nacks, "fail-closed denial must nack, not drop"
+        nk = conn.nacks[0]
+        assert nk.error_type == NackErrorType.THROTTLING
+        assert nk.content_code == 429 and nk.retry_after_s > 0
+        conn.nacks.clear()
+        conn.submit_frame(frame)  # the client contract: resubmit
+        assert svc.doc_head("fc-doc") == head + 1
+        seqs = [
+            m.sequence_number for m in svc.get_deltas("fc-doc")
+        ]
+        assert seqs == list(range(1, head + 2))
 
     def test_injected_faults_visible_on_metrics(self):
         faults.arm("queue.send", faults.FailN(1))

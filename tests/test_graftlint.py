@@ -593,6 +593,14 @@ def test_fault_site_accepts_documented_vocabulary(tmp_path):
         def feed(backend):
             backend.pump_stage()
             return backend.pump_dispatch()
+
+        @inject_fault("admission.decide")
+        def decide(ctl, tenant, doc, n):
+            return ctl.check(tenant, doc, n)
+
+        @inject_fault("shed.tier")
+        def evaluate(ctl, pressure):
+            return ctl.tier_for(pressure)
         """,
         tmp_path,
     )
@@ -619,6 +627,28 @@ def test_fault_site_flags_unregistered_feed_site(tmp_path):
     assert len(findings) == 1
     assert "unknown injection site" in findings[0].message
     assert "pump.feed_tick" in findings[0].message
+
+
+def test_fault_site_flags_unregistered_overload_site(tmp_path):
+    """The r13 regression shape: an overload boundary added to a
+    production module without declaring it in the vocabulary (e.g. a
+    second admission check named off-vocabulary) must fail lint — the
+    fail-closed contract (op nacked, never silently admitted) only
+    exists if the site is documented."""
+    findings = _run_pass(
+        _fault_site_pass(),
+        """
+        from fluidframework_tpu.testing.faults import inject_fault
+
+        @inject_fault("admission.precheck")
+        def precheck(ctl, tenant, doc):
+            return ctl.check(tenant, doc, 1)
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "unknown injection site" in findings[0].message
+    assert "admission.precheck" in findings[0].message
 
 
 def test_fault_site_flags_unregistered_recovery(tmp_path):

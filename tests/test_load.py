@@ -126,6 +126,48 @@ def test_load_chaos_toward_reference_profile():
     assert report.faults_injected > 0
 
 
+def test_load_full_stack_chaos_smoke():
+    """CI-sized version of the r13 full-stack chaos shape: tree traffic
+    plus the elected summarizer and periodic GC all ride the faulted
+    pipeline (foreman is on by default) — replicas converge, the
+    summarizer actually summarized, and the ingest-bucket delta (the
+    host_fallback_reason burn-down view) is captured in the report."""
+    from dataclasses import replace
+
+    profile = replace(
+        CHAOS_SMOKE_PROFILE, doc_id="chaos-full-smoke", tree_weight=0.25,
+        summary_interval=60, gc_every=120, total_ops=600,
+    )
+    report = LoadRunner(
+        PipelineFluidService(n_partitions=2), profile
+    ).run()
+    assert report.converged, f"divergence: {report}"
+    assert report.chaos_injected > 0
+    assert report.summaries > 0, "summarizer never ran under chaos"
+    assert report.gc_runs > 0
+    assert report.tree_ingest, "no tree ingest buckets captured"
+
+
+@pytest.mark.slow
+def test_load_chaos_stress_full_stack():
+    """The carried CHAOS_STRESS remainder (r13 satellite): the 48x3k
+    stress shape with summarizer/GC/foreman active under chaos. The
+    surviving host_fallback_reason buckets from this run are the
+    measured baseline recorded in STATUS.md for the
+    ring-evicted-move-source burn-down."""
+    from fluidframework_tpu.testing.load import CHAOS_STRESS_FULL_PROFILE
+
+    report = LoadRunner(
+        PipelineFluidService(n_partitions=4), CHAOS_STRESS_FULL_PROFILE
+    ).run()
+    assert report.converged, f"divergence: {report}"
+    assert report.ops_submitted == CHAOS_STRESS_FULL_PROFILE.total_ops
+    assert report.chaos_injected > 0
+    assert report.summaries > 0
+    assert report.gc_runs > 0
+    assert report.tree_ingest
+
+
 def test_slot_recycling_under_reconnect_churn():
     """Reconnect churn far beyond MAX_WRITERS must not exhaust a document:
     slots recycle once their leave falls below the collab-window floor."""

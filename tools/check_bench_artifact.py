@@ -34,7 +34,10 @@ from typing import List, Tuple
 # fault mix, parity-asserted — in r11; the continuous-front-door pair —
 # streaming-feed throughput (parity-pinned against the quiescence-gated
 # flush path on dense + mesh lanes) and the submit→device-commit feed
-# latency under continuous feed — in r12.
+# latency under continuous feed — in r12; the overload-envelope pair —
+# the goodput curve at 0.5x/1x/2x admission capacity (linear-not-cliff
+# asserted in-bench, gapless seq runs across every tier transition) and
+# the counted load-shedding tier transitions — in r13.
 REQUIRED = (
     ("pipeline_serving_ops_per_sec", 6),
     ("deli_scribe_e2e_ops_per_sec", 6),
@@ -47,6 +50,8 @@ REQUIRED = (
     ("fault_recovery_ops_per_sec", 11),
     ("serving_frontdoor_ops_per_sec", 12),
     ("serving_feed_latency_ms", 12),
+    ("overload_goodput_curve", 13),
+    ("serving_overload_tier_transitions", 13),
 )
 # Artifacts up to round 5 predate every gated metric.
 BASELINE_ROUND = 5
