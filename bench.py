@@ -767,6 +767,92 @@ def fault_recovery_benchmark(on_tpu: bool) -> dict:
     return rec
 
 
+def journal_overhead_benchmark(on_tpu: bool) -> dict:
+    """The r14 exit instrument: the flight recorder's cost on the
+    serving path. The SAME frame workload runs through the full pipeline
+    with the journal ON and OFF (interleaved, best-of-N per mode to damp
+    host jitter); ``journal_overhead_frac = 1 - rate_on / rate_off`` is
+    asserted ≤ 0.05 IN-bench before the number is reported — the journal
+    is a post-mortem instrument, not a serving tax. The on-lane also
+    proves the instrument works at bench scale: ``journal.lineage`` must
+    reconstruct the final round's op path (ticket → append → stage →
+    dispatch → commit → broadcast) from the ring."""
+    from fluidframework_tpu.models.shared_string import _MINT_STRIDE as mint
+    from fluidframework_tpu.protocol.opframe import OpFrame
+    from fluidframework_tpu.service.pipeline import PipelineFluidService
+    from fluidframework_tpu.telemetry import journal
+
+    n_docs, k, rounds, reps = (512, 16, 6, 2) if on_tpu else (24, 8, 4, 3)
+
+    def run() -> float:
+        svc = PipelineFluidService(
+            n_partitions=8, device_max_batch=max(1 << 17, n_docs * k),
+            checkpoint_every=500,
+        )
+        doc_ids = [f"jo{i}" for i in range(n_docs)]
+        conns = {d: svc.connect(d) for d in doc_ids}
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            items = []
+            for d in doc_ids:
+                conn = conns[d]
+                c0 = r * k + 1
+                origs = [conn.conn_no * mint + c0 + j for j in range(k)]
+                f = OpFrame.build(
+                    "s", ["ins"] * k, [0] * k, origs, ["x"] * k,
+                    csn0=c0, ref=svc.doc_head(d),
+                )
+                items.append((d, conn.client_id, f))
+            svc.submit_frames_bulk(items)
+        svc.pump()
+        svc.flush_device()
+        wall = time.perf_counter() - t0
+        assert all(svc.doc_head(d) > 0 for d in doc_ids[:2])
+        return n_docs * k * rounds / wall
+
+    was_on = journal.enabled()
+    try:
+        journal.enable()
+        journal.reset()
+        run()  # compile/dispatch warmup: both timed modes ride hot caches
+        on_rates, off_rates = [], []
+        for _ in range(reps):  # interleaved: drift hits both modes alike
+            journal.disable()
+            off_rates.append(run())
+            journal.enable()
+            journal.reset()
+            on_rates.append(run())
+        # The instrument check rides the LAST on-lane: the final round's
+        # op must reconstruct end-to-end from the ring.
+        head_seq = None
+        for ev in reversed(journal.JOURNAL.events()):
+            if ev.kind == "frame.ticket" and ev.doc == "jo0":
+                head_seq = ev.seq_hi
+                break
+        assert head_seq is not None, "journal captured no ticket events"
+        kinds = {e.kind for e in journal.lineage("jo0", head_seq)}
+        assert {
+            "frame.ticket", "log.append", "device.stage",
+            "device.dispatch", "device.commit", "broadcast",
+        } <= kinds, kinds
+    finally:
+        (journal.enable if was_on else journal.disable)()
+    on, off = max(on_rates), max(off_rates)
+    frac = max(0.0, round(1.0 - on / off, 4))
+    assert frac <= 0.05, (
+        f"journal overhead {frac} exceeds the 5% budget (on={on}, off={off})"
+    )
+    rec = {
+        "journal_overhead_frac": frac,
+        "journal_on_ops_per_sec": round(on),
+        "journal_off_ops_per_sec": round(off),
+        "journal_lineage_kinds": sorted(kinds),
+        "journal_shape": f"{n_docs}x{k}x{rounds}",
+    }
+    print(json.dumps({"metric": "journal_overhead_frac", **rec}))
+    return rec
+
+
 def overload_benchmark(on_tpu: bool) -> dict:
     """The r13 exit instrument: goodput at 0.5x / 1x / 2x the admitted
     capacity degrades LINEARLY, not cliff-shaped — at 2x offered load
@@ -970,6 +1056,15 @@ def serving_benchmarks(on_tpu: bool) -> dict:
                 out["serving_stage_spans_ms"] = (
                     _metrics.stage_span_summary()
                 )
+                # r14 satellite: tail estimates from the SAME fixed
+                # buckets (read-side interpolation, no new histogram
+                # state) — the p99 next to the mean, driver-carried.
+                out["serving_stage_p99_ms"] = {
+                    stage: row["p99"]
+                    for stage, row in _metrics.stage_span_summary(
+                        quantiles=(0.99,)
+                    ).items()
+                }
                 hist = _metrics.REGISTRY.get("serving_stage_ms")
                 out["serving_traces_completed"] = (
                     hist.count(stage="total") if hist is not None else 0
@@ -989,6 +1084,7 @@ def serving_benchmarks(on_tpu: bool) -> dict:
                 print(json.dumps({
                     "metric": "serving_stage_spans_ms",
                     "serving_stage_spans_ms": out["serving_stage_spans_ms"],
+                    "serving_stage_p99_ms": out["serving_stage_p99_ms"],
                     "device_shard_occupancy": out["device_shard_occupancy"],
                     "device_shard_err_docs": out["device_shard_err_docs"],
                 }))
@@ -1043,6 +1139,13 @@ def serving_benchmarks(on_tpu: bool) -> dict:
         out.update(overload_benchmark(on_tpu))
     except Exception as e:  # noqa: BLE001
         out["serving_error_overload"] = repr(e)[:500]
+    try:
+        # r14: the flight recorder's serving-path cost (journal-on vs
+        # journal-off, asserted ≤ 0.05 in-bench) plus the in-bench
+        # lineage-reconstruction proof.
+        out.update(journal_overhead_benchmark(on_tpu))
+    except Exception as e:  # noqa: BLE001
+        out["serving_error_journal"] = repr(e)[:500]
     try:
         import bench_configs as BC
 

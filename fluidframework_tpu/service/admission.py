@@ -59,6 +59,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, Optional, Tuple
 
 from fluidframework_tpu.service import retry
+from fluidframework_tpu.telemetry import journal
 from fluidframework_tpu.testing import faults
 from fluidframework_tpu.testing.faults import inject_fault
 
@@ -434,6 +435,16 @@ class AdmissionController:
         except faults.InjectedFault as e:
             if e.site != "admission.decide":
                 raise  # a nested site's fault keeps its own contract
+            if journal._ON:
+                journal.record(
+                    "retry.outcome", doc=doc_id, site="admission.decide",
+                    outcome="nack",
+                )
+            if isinstance(e, faults.InjectedCrash):
+                # A fail-closed CRASH is a flight-recorder trigger: the
+                # dump shows which ops were in flight when the front
+                # door slammed shut.
+                journal.auto_dump("admission-failed-closed")
             if isinstance(e, faults.InjectedCrash) and e.completed:
                 # Crash-AFTER: the inner decision ran — if it admitted,
                 # its tokens are spent on an op we are about to deny,
@@ -585,6 +596,7 @@ class OverloadController:
         self.transitions: list = []  # bounded (from_name, to_name) tail
         self._keep = int(keep_transitions)
         self.last_score = 0.0
+        self._last_jscore = 0.0  # last pressure score journaled
         # The tier gauge/transition counter are PROCESS-GLOBAL (one
         # serving envelope per process is the deployment shape);
         # deliberately no gauge write here — constructing a second
@@ -631,7 +643,27 @@ class OverloadController:
             if e.site != "shed.tier":
                 raise
             retry.retry_counter().inc(site="shed.tier", outcome="fallback")
+            if journal._ON:
+                journal.record(
+                    "retry.outcome", site="shed.tier", outcome="fallback"
+                )
             return self.tier
+        if journal._ON and (
+            new != self.tier
+            or abs(self.last_score - self._last_jscore) >= 0.05
+        ):
+            # Pressure readings journal on CHANGE, not per tick: the
+            # observe cadence is every pump sweep + every deadline tick,
+            # and a flat idle signal would churn the bounded ring out of
+            # exactly the lineage entries a post-mortem needs.
+            self._last_jscore = self.last_score
+            journal.record(
+                "pressure",
+                ring_frac=round(pressure.ring_frac, 4),
+                queue_frac=round(pressure.queue_frac, 4),
+                feed_lag_ms=round(pressure.feed_lag_ms, 3),
+                score=round(self.last_score, 4),
+            )
         if new != self.tier:
             self._transition(self.tier, new)
         return self.tier
@@ -648,6 +680,11 @@ class OverloadController:
     def _transition(self, old: Tier, new: Tier) -> None:
         transitions_counter().inc(from_tier=old.name, to_tier=new.name)
         tier_gauge().set(int(new))
+        if journal._ON:
+            journal.record(
+                "shed.transition", from_tier=old.name, to_tier=new.name,
+                score=round(self.last_score, 4),
+            )
         self.transitions.append((old.name, new.name))
         if len(self.transitions) > self._keep:
             # (an explicit length check: `del lst[:-keep]` is a silent

@@ -66,7 +66,7 @@ from fluidframework_tpu.parallel.fleet import (
 )
 from fluidframework_tpu.protocol.constants import F_ARG, F_SEQ, OP_WIDTH
 from fluidframework_tpu.service import retry
-from fluidframework_tpu.telemetry import metrics, tracing
+from fluidframework_tpu.telemetry import journal, metrics, tracing
 from fluidframework_tpu.testing import faults
 from fluidframework_tpu.testing.faults import inject_fault
 from fluidframework_tpu.utils import pow2_at_least as _pow2_at_least
@@ -84,15 +84,22 @@ class _RingSlot:
     the host copy (kept for the rare sharded-overflow re-route — it is
     the same buffer the staging pass built, so retaining it is free)."""
 
-    __slots__ = ("dev_rows", "host_rows", "docs", "lens", "rows", "traces")
+    __slots__ = (
+        "dev_rows", "host_rows", "docs", "lens", "rows", "traces", "jspans",
+    )
 
-    def __init__(self, dev_rows, host_rows, docs, lens, rows, traces):
+    def __init__(self, dev_rows, host_rows, docs, lens, rows, traces,
+                 jspans=()):
         self.dev_rows = dev_rows
         self.host_rows = host_rows
         self.docs = docs
         self.lens = lens
         self.rows = rows  # real (unpadded) row count staged
         self.traces = traces
+        # Flight-recorder coverage: per-channel (doc, seq_lo, seq_hi)
+        # runs this boxcar carries — stamped once at stage time, reused
+        # by the dispatch and commit events (journal-off: empty).
+        self.jspans = jspans
 
 
 class IngestRing:
@@ -177,6 +184,11 @@ class DeviceFleetBackend:
         # scan that covers their boxcar. Untraced frames never land here.
         self._trace_pending: List[list] = []
         self._trace_inflight: List[list] = []
+        # Flight-recorder in-flight spans: boxcars dispatched but whose
+        # health scan (= the commit signal) has not been consumed yet —
+        # the journal mirror of _trace_inflight, fed by the SAME
+        # one-boxcar-stale scan (zero new readbacks by construction).
+        self._journal_inflight: List[tuple] = []
         self._errored: set = set()  # fleet ids already reported
         self._unreported: List[ChannelKey] = []
         self.ops_applied = 0
@@ -417,14 +429,19 @@ class DeviceFleetBackend:
             return self._flush_pump()
         return self._flush_oneshot()
 
-    def _stage_host(self) -> Tuple[np.ndarray, List[np.ndarray], np.ndarray]:
+    def _stage_host(
+        self,
+    ) -> Tuple[np.ndarray, List[np.ndarray], np.ndarray, tuple]:
         """One boxcar's host assembly, shared by the pump and one-shot
         paths: drain the channel buffers up to each doc's chunk limit
         (the over-limit remainder stays buffered for the next boxcar) and
         run the watermark bookkeeping as two fancy-indexed array ops —
         the per-channel dict loop this replaces was residual Python wall
         inside the pump at 10k+ busy channels (r10 satellite). Returns
-        ``(idxs, rows_list, lens)``."""
+        ``(idxs, rows_list, lens, jspans)`` — ``jspans`` is the flight-
+        recorder coverage tuple (per-channel ``(doc, lo, hi)`` seq runs;
+        empty with the journal disabled, so the hot path pays one
+        predicate)."""
         buffers = self._buffers
         n = len(buffers)
         idxs = np.fromiter(buffers.keys(), np.int64, n)
@@ -477,7 +494,17 @@ class DeviceFleetBackend:
         self._applied_a[idxs] = np.maximum(self._applied_a[idxs], seqs)
         self._since_a[idxs] += lens
         self.ops_applied += int(lens.sum())
-        return idxs, rows_list, lens
+        jspans: tuple = ()
+        if journal._ON:
+            keys = self._keys
+            jspans = tuple(
+                (keys[int(idx)][0], int(r[0, F_SEQ]), int(hi))
+                for idx, r, hi in zip(idxs, rows_list, seqs)
+            )
+            journal.record(
+                "device.stage", spans=jspans, rows=int(lens.sum())
+            )
+        return idxs, rows_list, lens, jspans
 
     def _flush_oneshot(self) -> List[ChannelKey]:
         """The pre-pump serving loop (the pump's parity reference)."""
@@ -496,7 +523,7 @@ class DeviceFleetBackend:
             # channel shipped the same row count (the round-shaped frame
             # wire's common case).
             t0 = time.perf_counter()
-            idxs, rows_list, lens = self._stage_host()
+            idxs, rows_list, lens, jspans = self._stage_host()
             n = len(idxs)
             if self._sharded:
                 shard_sel = np.fromiter(
@@ -534,6 +561,9 @@ class DeviceFleetBackend:
                 dispatch_s += (t2 - t1) - self.fleet.last_routing_s
                 staged_rows += ops_b.shape[0] * k
                 self._scan_token = self.fleet.begin_scan()
+                if jspans:
+                    journal.record("device.dispatch", spans=jspans)
+                    self._journal_inflight.append(jspans)
             else:
                 staging_s += time.perf_counter() - t0
             self._flushes += 1
@@ -594,6 +624,12 @@ class DeviceFleetBackend:
         this flush (one-shot path) or whose rows were all replay-dropped
         (either path) — close their device span against the (possibly
         vacuous) in-flight scan."""
+        if self._scan_token is None:
+            # No scan in flight covers them: any boxcars still awaiting
+            # a journal commit (e.g. an all-sharded slot that began no
+            # scan) close here — NOT gated on trace state, or untraced
+            # sharded traffic would pin _journal_inflight forever.
+            self._flush_journal_commits()
         if not self._trace_pending:
             return
         for t in self._trace_pending:
@@ -606,6 +642,15 @@ class DeviceFleetBackend:
         else:
             self._trace_inflight.extend(self._trace_pending)
         self._trace_pending = []
+
+    def _flush_journal_commits(self) -> None:
+        """Record the commit event for every boxcar whose covering scan
+        has been consumed (or that needed none — an all-sharded slot)."""
+        if self._journal_inflight:
+            if journal._ON:
+                for sp in self._journal_inflight:
+                    journal.record("device.commit", spans=sp)
+            self._journal_inflight = []
 
     def _pump_stage_counted(self) -> bool:
         """Stage one boxcar with the ``pump.stage`` recovery accounting:
@@ -621,6 +666,11 @@ class DeviceFleetBackend:
                 retry.retry_counter().inc(
                     site="pump.stage", outcome="requeue"
                 )
+                if journal._ON:
+                    journal.record(
+                        "retry.outcome", site="pump.stage",
+                        outcome="requeue",
+                    )
             raise
 
     @inject_fault("pump.stage")
@@ -652,7 +702,7 @@ class DeviceFleetBackend:
         for t in traces:
             tracing.stamp(t, tracing.STAGE_FEED_WAIT, "end")
             tracing.stamp(t, tracing.STAGE_RING_STAGE, "start")
-        idxs, rows_list, lens = self._stage_host()
+        idxs, rows_list, lens, jspans = self._stage_host()
         n = len(idxs)
         k = _pow2_at_least(max(int(lens.max()), 8))
         b = _pow2_at_least(n)
@@ -667,7 +717,10 @@ class DeviceFleetBackend:
         for t in traces:
             tracing.stamp(t, tracing.STAGE_RING_STAGE, "end")
         self._ring.push(
-            _RingSlot(dev_rows, rows_b, idxs, lens, int(lens.sum()), traces)
+            _RingSlot(
+                dev_rows, rows_b, idxs, lens, int(lens.sum()), traces,
+                jspans,
+            )
         )
         self.flush_totals["staging_s"] += time.perf_counter() - t0
         self.flush_totals["staged_rows"] += b * k
@@ -702,6 +755,10 @@ class DeviceFleetBackend:
         Watermarks advanced at stage time and the slot is consumed exactly
         once, so the fallback preserves no-lost/no-dup by construction."""
         retry.retry_counter().inc(site="pump.dispatch", outcome="fallback")
+        if journal._ON:
+            journal.record(
+                "retry.outcome", site="pump.dispatch", outcome="fallback"
+            )
         n = len(slot.docs)
         sel = np.flatnonzero(in_fleet)
         self.fleet.apply_sparse(slot.docs[sel], slot.host_rows[:n][sel])
@@ -738,12 +795,18 @@ class DeviceFleetBackend:
                     retry.retry_counter().inc(
                         site="pump.dispatch", outcome="requeue"
                     )
+                    if journal._ON:
+                        journal.record(
+                            "retry.outcome", site="pump.dispatch",
+                            outcome="requeue",
+                        )
                 else:
                     # The dispatch landed; the crash only cost the ack.
                     # Nothing to recover — surfaced to the supervisor.
                     retry.retry_counter().inc(
                         site="pump.dispatch", outcome="fatal"
                     )
+                    journal.retry_outcome("pump.dispatch", "fatal")
                 raise
             except faults.InjectedFault:
                 # Injected dispatch failure: the wrapper fires BEFORE any
@@ -761,9 +824,13 @@ class DeviceFleetBackend:
                 retry.retry_counter().inc(
                     site="pump.dispatch", outcome="fatal"
                 )
+                journal.retry_outcome("pump.dispatch", "fatal")
                 raise
             self._scan_token = self.fleet.begin_scan()
             self._scan_dispatch_t = time.perf_counter()
+        if slot.jspans:
+            journal.record("device.dispatch", spans=slot.jspans)
+            self._journal_inflight.append(slot.jspans)
         for t in slot.traces:
             tracing.stamp(t, tracing.STAGE_DEVICE_STEP, "end")
         if slot.traces:
@@ -891,6 +958,13 @@ class DeviceFleetBackend:
                 retry.retry_counter().inc(
                     site="pump.feed", outcome=outcome
                 )
+                if outcome == "fatal":
+                    journal.retry_outcome("pump.feed", "fatal")
+                elif journal._ON:
+                    journal.record(
+                        "retry.outcome", site="pump.feed",
+                        outcome="requeue",
+                    )
             raise
 
     def pump_feed_absorbed(self) -> List[ChannelKey]:
@@ -1015,6 +1089,10 @@ class DeviceFleetBackend:
             self._scan_dispatch_t = None
         for t in self._trace_inflight:
             tracing.stamp(t, tracing.STAGE_SCAN_CONSUME, "end")
+        # The scan consume IS the commit signal (the same one-boxcar-
+        # stale readback the nack path rides — never an extra transfer):
+        # every in-flight boxcar's journal commit closes here.
+        self._flush_journal_commits()
         self._consume_scan(scans, newly)
 
     def _consume_scan(
@@ -1097,6 +1175,14 @@ class DeviceFleetBackend:
             if doc.err != 0 and idx not in self._errored:
                 self._errored.add(idx)
                 out.append(self._keys[idx])
+        if out and journal._ON:
+            # Err-lane trip: one journal event per newly errored channel
+            # (consumed from the EXISTING scan — zero new readbacks) and
+            # one auto-dump, so the post-mortem file carries the lineage
+            # of the ops that drove the channel into the lane.
+            for doc_id, address in out:
+                journal.record("device.err", doc=doc_id, addr=address)
+            journal.auto_dump("err_lane")
         return out
 
     def _doc_state(self, idx: int):

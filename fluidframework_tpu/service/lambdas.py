@@ -37,7 +37,13 @@ from fluidframework_tpu.protocol.types import (
 )
 from fluidframework_tpu.service import retry
 from fluidframework_tpu.service.queue import PartitionedLog
-from fluidframework_tpu.telemetry import LumberEventName, Lumberjack, metrics, tracing
+from fluidframework_tpu.telemetry import (
+    LumberEventName,
+    Lumberjack,
+    journal,
+    metrics,
+    tracing,
+)
 from fluidframework_tpu.testing.faults import inject_fault
 from fluidframework_tpu.service.sequencer import (
     DocumentSequencer,
@@ -469,8 +475,24 @@ class DeliDocLambda(PartitionLambda):
         if traces is not None:
             tracing.stamp(traces, tracing.STAGE_DELI, "end")
         if res is None:
+            # Whole-frame duplicate (MSN/csn dedup): silently dropped on
+            # the wire by contract — but the flight recorder remembers,
+            # so a dup-nacked op's lineage shows WHERE its resubmit died.
+            if journal._ON:
+                journal.record(
+                    "frame.nack", doc=key, client=client, csn=frame.csn0,
+                    csn_hi=frame.csn0 + frame.n - 1, reason="dup",
+                )
             return []
         if isinstance(res, NackMessage):
+            if journal._ON:
+                journal.record(
+                    "frame.nack", doc=key, client=client, csn=frame.csn0,
+                    csn_hi=frame.csn0 + frame.n - 1,
+                    reason=getattr(
+                        res.error_type, "name", str(res.error_type)
+                    ),
+                )
             return [(DELTAS_TOPIC, key, {"t": "nack", "client": client,
                                          "nack": res})]
         assert isinstance(res, FrameTicket)
@@ -491,6 +513,16 @@ class DeliDocLambda(PartitionLambda):
             frame.address, client, frame.csn0 + res.drop, rows, texts,
             res.timestamp,
         )
+        if journal._ON:
+            # The ticket event is the lineage JOIN point: it maps the
+            # op's pre-sequencing identity (client, csn) to its sequence
+            # number, so journal.lineage(doc, seq) can pull in the
+            # submit/admit half recorded before a seq existed.
+            journal.record(
+                "frame.ticket", doc=key, seq=res.seq0,
+                seq_hi=res.seq0 + res.m - 1, csn=frame.csn0 + res.drop,
+                csn_hi=frame.csn0 + res.drop + res.m - 1, client=client,
+            )
         seq_rec: Dict[str, Any] = {"t": "seqframe", "frame": sf}
         if traces is not None:
             # The SAME list object rides the sequenced record: every
@@ -685,6 +717,11 @@ class ScriptoriumLambda(PartitionLambda):
             retry.call_with_retry(
                 "store.append", self._doc(key).add_msg, value["msg"]
             )
+            if journal._ON:
+                journal.record(
+                    "log.append", doc=key,
+                    seq=value["msg"].sequence_number,
+                )
         elif value["t"] == "seqframe":
             traces = value.get("traces")
             if traces is not None:
@@ -694,6 +731,11 @@ class ScriptoriumLambda(PartitionLambda):
             )
             if traces is not None:
                 tracing.stamp(traces, tracing.STAGE_SCRIPTORIUM, "end")
+            if journal._ON:
+                journal.record(
+                    "log.append", doc=key, seq=value["frame"].first_seq,
+                    seq_hi=value["frame"].last_seq,
+                )
         return []
 
     def handler_batch(self, recs) -> List[Tuple[str, str, Any]]:
@@ -715,10 +757,21 @@ class ScriptoriumLambda(PartitionLambda):
                 )
                 if traces is not None:
                     tracing.stamp(traces, tracing.STAGE_SCRIPTORIUM, "end")
+                if journal._ON:
+                    journal.record(
+                        "log.append", doc=rec.key,
+                        seq=value["frame"].first_seq,
+                        seq_hi=value["frame"].last_seq,
+                    )
             elif t == "seq":
                 retry.call_with_retry(
                     "store.append", self._doc(rec.key).add_msg, value["msg"]
                 )
+                if journal._ON:
+                    journal.record(
+                        "log.append", doc=rec.key,
+                        seq=value["msg"].sequence_number,
+                    )
         return []
 
     def state(self) -> Any:
@@ -766,6 +819,11 @@ class BroadcasterLambda(PartitionLambda):
                 # downstream) from double-observing the span.
                 tracing.stamp(msg.traces, tracing.STAGE_ALFRED, "end")
                 metrics.observe_stage_spans(tracing.spans(msg.traces))
+            if journal._ON:
+                journal.record(
+                    "broadcast", doc=key, seq=msg.sequence_number,
+                    conns=len(conns),
+                )
             for conn in conns:
                 if msg.sequence_number > conn.delivered_seq:
                     conn.inbox.append(msg)
@@ -778,6 +836,11 @@ class BroadcasterLambda(PartitionLambda):
             traces = value.get("traces")
             if traces is not None:
                 tracing.stamp(traces, tracing.STAGE_BROADCAST, "start")
+            if journal._ON:
+                journal.record(
+                    "broadcast", doc=key, seq=frame.first_seq,
+                    seq_hi=frame.last_seq, conns=len(conns),
+                )
             for conn in conns:
                 if frame.last_seq <= conn.delivered_seq:
                     continue

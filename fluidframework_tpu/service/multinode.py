@@ -533,6 +533,17 @@ class MultiNodeFluidService:
             # already carries this client — and it is ticketed exactly
             # once. Never silent: retry_attempts_total{lease.renew,fence}.
             retry.retry_counter().inc(site="lease.renew", outcome="fence")
+            from fluidframework_tpu.telemetry import journal
+
+            if journal._ON:
+                # The flight recorder keeps the fence itself (which op
+                # was rerouted, to which owner) — the counter only says
+                # a fence happened somewhere.
+                journal.record(
+                    "lease.fence", doc=doc_id, client=client_id,
+                    csn=msg.client_sequence_number,
+                    new_owner=self.cluster.owner(doc_id).name,
+                )
             node = self.cluster.owner(doc_id)
             res = node.ticket(doc_id, client_id, msg)
         if isinstance(res, NackMessage):

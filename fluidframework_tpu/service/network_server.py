@@ -208,9 +208,14 @@ class FluidNetworkServer:
             # reads its scale-up signal there precisely when the
             # envelope is at its worst; refusing the scrape would pin
             # the server at tier 3 with no one able to see it.
+            # /debugz shares the /metrics exemption: the flight
+            # recorder is read precisely when the envelope is at its
+            # worst — refusing the post-mortem surface at tier 3 would
+            # blind the one reader who needs it.
             ov = getattr(self.service, "overload", None)
             if ov is not None and ov.refuse_connections() and not (
-                method == "GET" and urlparse(path).path == "/metrics"
+                method == "GET"
+                and urlparse(path).path in ("/metrics", "/debugz")
             ):
                 self.connections_refused += 1
                 admission.shed_counter().inc(kind="connection")
@@ -275,6 +280,20 @@ class FluidNetworkServer:
                 + payload
             )
 
+        if method == "GET" and parts == ["debugz"]:
+            # The flight recorder (r14): replica-deterministic journal
+            # render — pure host state, ZERO device readbacks (the
+            # journal consumes the existing scan/scrape data only), and
+            # exempt from shed tiers exactly like /metrics (handled
+            # BEFORE the SHED_READS branch below).
+            from fluidframework_tpu.telemetry import journal
+
+            reply(
+                200, journal.render().encode(),
+                ctype="text/plain; charset=utf-8",
+            )
+            await writer.drain()
+            return
         if method == "GET" and parts == ["metrics"]:
             # Prometheus exposition (unauthenticated, like the health
             # surface): refresh the device gauges with the contractual

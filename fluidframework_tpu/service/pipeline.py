@@ -48,7 +48,7 @@ from fluidframework_tpu.service.admission import (
 )
 from fluidframework_tpu.service.queue import PartitionedLog
 from fluidframework_tpu.service.summary_store import SummaryStore
-from fluidframework_tpu.telemetry import tracing
+from fluidframework_tpu.telemetry import journal, tracing
 from fluidframework_tpu.testing.faults import inject_fault
 
 
@@ -589,6 +589,15 @@ class PipelineFluidService:
         conn = None
         scanned = False
         tenant = "local"
+        if journal._ON:
+            # The submit event anchors the op's PRE-sequencing identity
+            # (doc, client, csn) in the flight recorder — the half of
+            # the lineage that exists before a sequence number does.
+            journal.record(
+                "frame.submit", doc=doc_id, client=client_id, csn=csn,
+                csn_hi=(csn + n_ops - 1) if csn >= 0 else None,
+                n=n_ops,
+            )
         if not adm.permissive():
             # Tenant resolution (a bounded room scan — MAX_WRITERS
             # entries) only once the envelope is engaged; the
@@ -599,6 +608,20 @@ class PipelineFluidService:
             if conn is not None:
                 tenant = conn.tenant
         d = adm.decide(tenant, doc_id, n_ops, tier=self.overload.tier)
+        if journal._ON:
+            journal.record(
+                "admission.admit" if d.admitted else "admission.deny",
+                doc=doc_id, client=client_id, csn=csn,
+                csn_hi=(csn + n_ops - 1) if csn >= 0 else None,
+                **(
+                    {}
+                    if d.admitted
+                    else {
+                        "reason": d.reason,
+                        "retry_after_ms": round(d.retry_after_ms, 3),
+                    }
+                ),
+            )
         if d.admitted:
             return True
         if not scanned:

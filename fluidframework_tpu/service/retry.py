@@ -148,6 +148,8 @@ def _retry_slow(
     site, fn, args, kwargs, first_exc, policy, retryable, fatal, sleep,
     rng, registry,
 ):
+    from fluidframework_tpu.telemetry import journal
+
     if not isinstance(first_exc, Exception):
         raise first_exc  # KeyboardInterrupt etc.: not a recovery event
     counter = retry_counter(registry)
@@ -157,12 +159,18 @@ def _retry_slow(
     while True:
         if isinstance(exc, fatal) or not isinstance(exc, retryable):
             counter.inc(site=site, outcome="fatal")
+            # Flight recorder (r14): a fatal outcome means the op needs
+            # its stage's replay/drain contract — journal it AND fire
+            # the auto-dump, so the post-mortem file holds the lineage
+            # that led here (the counter alone says only "it happened").
+            journal.retry_outcome(site, "fatal")
             raise exc
         # ``retry`` counts only attempts that schedule a follow-up (the
         # documented meaning); the final failure counts once, as
         # ``exhausted``.
         if attempt >= policy.max_attempts:
             counter.inc(site=site, outcome="exhausted")
+            journal.retry_outcome(site, "exhausted")
             raise exc
         delay = policy.delay(attempt, rng)
         if (
@@ -170,8 +178,11 @@ def _retry_slow(
             and time.monotonic() - t0 + delay > policy.deadline_s
         ):
             counter.inc(site=site, outcome="exhausted")
+            journal.retry_outcome(site, "exhausted")
             raise exc
         counter.inc(site=site, outcome="retry")
+        if journal._ON:
+            journal.record("retry.outcome", site=site, outcome="retry")
         if delay > 0:
             sleep(delay)
         attempt += 1
@@ -181,4 +192,6 @@ def _retry_slow(
             exc = e
             continue
         counter.inc(site=site, outcome="ok")
+        if journal._ON:
+            journal.record("retry.outcome", site=site, outcome="ok")
         return result

@@ -84,14 +84,21 @@ class _Handler(socketserver.StreamRequestHandler):
                 return
             if not line:
                 return
-            if line.split(b" ")[:2] == [b"GET", b"/metrics"]:
-                # Prometheus scrape on the store port: plain HTTP on the
-                # same socket (the store node has no separate admin
-                # listener) — drain the request head, render, close.
+            if line.split(b" ")[:2] in (
+                [b"GET", b"/metrics"], [b"GET", b"/debugz"]
+            ):
+                # Prometheus scrape / flight-recorder read on the store
+                # port: plain HTTP on the same socket (the store node
+                # has no separate admin listener) — drain the request
+                # head, render, close.
                 try:
                     while self.rfile.readline() not in (b"\r\n", b"\n", b""):
                         pass
-                    self.connection.sendall(srv.metrics_payload())
+                    self.connection.sendall(
+                        srv.debugz_payload()
+                        if b"/debugz" in line.split(b" ")[:2]
+                        else srv.metrics_payload()
+                    )
                 except OSError:
                     pass
                 return
@@ -286,6 +293,21 @@ class StoreServer:
         rec = LogRecord(offset=len(log), key=key, value=body)
         log.append(rec)
         return p, rec.offset
+
+    def debugz_payload(self) -> bytes:
+        """One complete HTTP response carrying the flight-recorder
+        journal (replica-deterministic text, telemetry/journal.py) — the
+        store node's ``GET /debugz``. Device-free like the rest of the
+        node: the journal consumes host state only."""
+        from fluidframework_tpu.telemetry import journal
+
+        body = journal.render().encode()
+        return (
+            "HTTP/1.1 200 OK\r\n"
+            "Content-Type: text/plain; charset=utf-8\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            "Connection: close\r\n\r\n"
+        ).encode() + body
 
     def metrics_payload(self) -> bytes:
         """One complete HTTP response carrying the process registry in

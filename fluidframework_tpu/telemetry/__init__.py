@@ -25,7 +25,7 @@ from fluidframework_tpu.telemetry.lumberjack import (
     LumberEventName,
     Lumberjack,
 )
-from fluidframework_tpu.telemetry import metrics, tracing
+from fluidframework_tpu.telemetry import journal, metrics, tracing
 from fluidframework_tpu.telemetry.metrics import (
     Counter,
     Gauge,
@@ -52,5 +52,6 @@ __all__ = [
     "MonitoringContext",
     "PerformanceEvent",
     "TelemetryLogger",
+    "journal",
     "tracing",
 ]

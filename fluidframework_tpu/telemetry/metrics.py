@@ -327,23 +327,80 @@ def tree_ingest_counter(registry: Optional[MetricsRegistry] = None) -> Counter:
     )
 
 
+def _bucket_quantile(
+    buckets: Tuple[float, ...], counts: Sequence[float], q: float
+) -> float:
+    """One quantile estimate from fixed-bucket counts (per-bucket, NOT
+    cumulative), the ``histogram_quantile`` interpolation: walk the
+    cumulative counts to the target rank, then interpolate linearly
+    inside the bucket (lower edge = previous bound, 0 for the first).
+    Ranks landing in the +Inf bucket return the highest finite bound —
+    the honest answer a fixed-bucket histogram can give."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0.0
+    for i, b in enumerate(buckets):
+        prev_cum = cum
+        cum += counts[i]
+        if cum >= target:
+            lo = buckets[i - 1] if i else 0.0
+            frac = (target - prev_cum) / counts[i] if counts[i] else 0.0
+            return lo + (b - lo) * frac
+    return buckets[-1]
+
+
 def stage_span_summary(
     registry: Optional[MetricsRegistry] = None,
-) -> Dict[str, float]:
-    """Mean observed duration per stage (ms) from the shared stage
-    histogram — the compact ``serving_stage_spans_ms`` form bench.py
-    merges into the driver artifact."""
+    quantiles: Sequence[float] = (),
+) -> Dict[str, Any]:
+    """Per-stage summary from the shared stage histogram. The default
+    (no ``quantiles``) keeps the r9 shape — ``{stage: mean_ms}``, the
+    compact ``serving_stage_spans_ms`` form bench.py merges into the
+    driver artifact. With ``quantiles`` (e.g. ``(0.5, 0.95, 0.99)``)
+    each stage maps to ``{"mean": …, "p50": …, "p95": …, "p99": …}`` —
+    estimates interpolated from the SAME fixed buckets (no new state,
+    no new histogram type: scrapes across replicas stay mergeable, the
+    quantile is a read-side reduction)."""
     reg = registry or REGISTRY
     hist = reg.get("serving_stage_ms")
     if not isinstance(hist, Histogram):
         return {}
-    out: Dict[str, float] = {}
+    out: Dict[str, Any] = {}
     with hist._lock:  # snapshot: observe() may be inserting a new stage
         rows = [
-            (dict(key), sum(row[:-1]), row[-1])
+            (dict(key), list(row[:-1]), row[-1])
             for key, row in sorted(hist._values.items())
         ]
-    for labels, n, total in rows:
-        if n:
-            out[labels.get("stage", "")] = round(total / n, 3)
+    for labels, counts, total in rows:
+        n = sum(counts)
+        if not n:
+            continue
+        stage = labels.get("stage", "")
+        if not quantiles:
+            out[stage] = round(total / n, 3)
+        else:
+            row: Dict[str, float] = {"mean": round(total / n, 3)}
+            for q in quantiles:
+                row[f"p{round(q * 100):g}"] = round(
+                    _bucket_quantile(hist.buckets, counts, float(q)), 3
+                )
+            out[stage] = row
     return out
+
+
+def trace_dropped_counter(
+    registry: Optional[MetricsRegistry] = None,
+) -> Counter:
+    """``trace_frames_dropped_total{reason}``, registered in ONE place
+    (the ``tree_ingest_counter`` idiom): traces evicted incomplete from
+    the ``TraceBook`` ledger used to vanish silently into the host-side
+    ``dropped`` int — sampled-trace loss is an observability gap the
+    registry must count."""
+    reg = registry or REGISTRY
+    return reg.counter(
+        "trace_frames_dropped_total",
+        "sampled frame traces dropped before completing, by reason",
+        labelnames=("reason",),
+    )

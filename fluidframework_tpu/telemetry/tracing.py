@@ -149,9 +149,18 @@ class TraceBook:
         self._live.append(traces)
         if len(self._live) > self.max_live:
             # Incomplete stragglers (nacked frames, replay-duplicate
-            # drops) must not pin memory forever: evict oldest-first.
-            self.dropped += len(self._live) - self.max_live
-            del self._live[: len(self._live) - self.max_live]
+            # drops) must not pin memory forever: evict oldest-first —
+            # and COUNT the loss on the registry (r14 satellite): a
+            # trace aging out of the ledger is sampled observability
+            # silently discarded, which the scrape must be able to see.
+            n = len(self._live) - self.max_live
+            self.dropped += n
+            del self._live[:n]
+            from fluidframework_tpu.telemetry import metrics
+
+            metrics.trace_dropped_counter(self._registry).inc(
+                n, reason="max_live"
+            )
         return traces
 
     def _complete(self, traces: List[dict]) -> bool:

@@ -100,6 +100,15 @@ SITES: Dict[str, str] = {
     # slam it shut); the next observation re-evaluates from live
     # pressure.
     "shed.tier": "fallback",
+    # Flight-recorder auto-dump (telemetry/journal.py _write_dump — the
+    # r14 post-mortem file write): the journal is best-effort by
+    # contract — a failed or crashed dump is counted
+    # (retry_attempts_total{journal.dump,fallback}) and ABSORBED by
+    # auto_dump, so the flight recorder can never become the outage it
+    # exists to explain. The in-memory ring (and /debugz) still holds
+    # the events; crash-after leaves the file durable with only the
+    # bookkeeping event lost.
+    "journal.dump": "fallback",
 }
 
 #: The recovery kinds the contract table documents. A site mapped to
@@ -267,6 +276,14 @@ class FaultRegistry:
                 self.injected.get((site, kind), 0) + 1
             )
         injected_counter().inc(site=site, kind=kind)
+        # Flight recorder (r14): every injection is a journal event, so
+        # an auto-dump after the recovery shows WHICH fault preceded it.
+        # Never for journal.dump itself — an armed dump site would
+        # journal-from-within-the-dump path recursively.
+        from fluidframework_tpu.telemetry import journal
+
+        if journal._ON and site != "journal.dump":
+            journal.record("fault.injected", site=site, fault=kind)
 
     def _invoke(self, site: str, fn: Callable, args: tuple, kwargs: dict):
         with self._lock:

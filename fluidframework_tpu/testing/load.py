@@ -90,6 +90,11 @@ class LoadReport:
     trees: list = field(default_factory=list)  # per-replica tree views
     summaries: int = 0  # summarize ops sequenced during the run
     gc_runs: int = 0
+    # Flight recorder (r14): on a convergence/parity failure the journal
+    # auto-dumps into its configured dump_dir (the chaos harness points
+    # it at the test artifact dir) and the path lands here — "replicas
+    # diverged" arrives with the event stream that explains it.
+    journal_dump: Optional[str] = None
     # tree_ingest_commits_total{path,reason} DELTA over the run — the
     # host_fallback_reason burn-down view (STATUS.md baseline).
     tree_ingest: dict = field(default_factory=dict)
@@ -323,6 +328,10 @@ class LoadRunner:
             and all(m == maps[0] for m in maps)
             and all(t == trees[0] for t in trees)
         )
+        if not report.converged:
+            from fluidframework_tpu.telemetry import journal
+
+            report.journal_dump = journal.auto_dump("load-divergence")
         report.final_text_len = len(texts[0])
         report.nacks = sum(len(rt.connection.nacks) for rt in runtimes)
         post_ingest = _ingest_buckets()

@@ -314,6 +314,14 @@ class EditManager:
             self.host_fallback_reason.get(key, 0) + n
         )
         metrics.tree_ingest_counter().inc(n, path="host", reason=key)
+        from fluidframework_tpu.telemetry import journal
+
+        if journal._ON:
+            # Flight recorder (r14): the host_fallback_reason burn-down
+            # needs per-event attribution, not just buckets — the
+            # journal keeps WHICH ingest fell back and why, interleaved
+            # with the op lineage that caused it.
+            journal.record("tree.fallback", reason=key, n=n)
 
     @staticmethod
     def _err_reason(err: int) -> str:

@@ -292,6 +292,41 @@ def test_r12_exempt_from_overload_keys(tmp_path):
     assert cba.check(str(tmp_path)) == 0
 
 
+_R13_COMPLETE = dict(
+    _R12_COMPLETE,
+    overload_goodput_curve={"0.5x": 8.0, "1x": 16.0, "2x": 15.5},
+    serving_overload_tier_transitions={"NORMAL->SHED_READS": 1},
+)
+
+
+def test_r14_requires_journal_keys(tmp_path):
+    """An r14+ artifact must carry the flight-recorder pair — the
+    measured journal-on/journal-off serving overhead AND the per-stage
+    p99 tail next to the r9 means."""
+    cba = _tool()
+    _write(tmp_path, "BENCH_r14.json", [json.dumps(_R13_COMPLETE)])
+    assert cba.check(str(tmp_path)) == 1
+    # One of the pair is not enough.
+    _write(tmp_path, "BENCH_r14.json", [json.dumps(dict(
+        _R13_COMPLETE, journal_overhead_frac=0.012,
+    ))])
+    assert cba.check(str(tmp_path)) == 1
+    _write(tmp_path, "BENCH_r14.json", [json.dumps(dict(
+        _R13_COMPLETE,
+        journal_overhead_frac=0.012,
+        serving_stage_p99_ms={"deli": 0.4, "total": 9.1},
+    ))])
+    assert cba.check(str(tmp_path)) == 0
+
+
+def test_r13_exempt_from_journal_keys(tmp_path):
+    """Per-key since-round gating: an r13 artifact predates the
+    flight-recorder pair and passes with the thirteen prior keys."""
+    cba = _tool()
+    _write(tmp_path, "BENCH_r13.json", [json.dumps(_R13_COMPLETE)])
+    assert cba.check(str(tmp_path)) == 0
+
+
 def test_newest_round_governs(tmp_path):
     cba = _tool()
     _write(tmp_path, "BENCH_r05.json", ['{"metric": "old"}'])

@@ -50,6 +50,38 @@ def _clean_faults():
     faults.reset()
 
 
+@pytest.fixture(autouse=True)
+def _flight_recorder(tmp_path):
+    """The chaos harness's artifact contract (r14): the flight recorder
+    dumps into the test artifact dir on any parity failure (and on the
+    fatal/exhausted outcomes the matrix provokes), so "bit-exact
+    assertion failed" ships with the event stream that explains it."""
+    import os
+
+    from fluidframework_tpu.telemetry import journal
+
+    journal.enable()
+    journal.configure(
+        dump_dir=os.environ.get("TEST_ARTIFACT_DIR") or str(tmp_path)
+    )
+    journal.reset()
+    yield
+    journal.JOURNAL.dump_dir = None
+    journal.reset()
+
+
+def _assert_parity(state, ref, label):
+    """Bit-exact post-recovery parity, with the r14 post-mortem: a miss
+    auto-dumps the journal before failing the test."""
+    if state != ref:
+        from fluidframework_tpu.telemetry import journal
+
+        path = journal.auto_dump("chaos-parity")
+        raise AssertionError(
+            f"{label} diverged from unfaulted run; journal dump: {path}"
+        )
+
+
 def _recovery_total(site, outcome=None) -> float:
     c = metrics.REGISTRY.get("retry_attempts_total")
     if c is None:
@@ -293,7 +325,7 @@ class TestChaosMatrix:
             arm=lambda: faults.arm(site, _policy(kind))
         )
         assert faults.REGISTRY.injected_total(site) == 1, faults.stats()
-        assert state == ref, f"{site}/{kind} diverged from unfaulted run"
+        _assert_parity(state, ref, f"{site}/{kind}")
         # No silent recovery: the unified counter family moved for this
         # site (retry/ok for retried sites, fallback/requeue for the
         # pump, fatal for crashes that propagate to the supervisor).
@@ -313,7 +345,7 @@ class TestChaosMatrix:
                 faults.arm(site, faults.FailProb(0.15, seed=41 + i))
 
         state = _run_chaos_workload(arm=arm)
-        assert state == ref
+        _assert_parity(state, ref, "fault-mix")
         assert faults.REGISTRY.injected_total() > 0
 
     def test_crashed_admission_check_fails_closed_with_nack(self):

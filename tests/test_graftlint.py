@@ -783,3 +783,70 @@ def test_scope_files_matches_outside_package(tmp_path):
         str(tmp_path), ("tools/*.py", "fluidframework_tpu/*.py")
     )
     assert got == ["fluidframework_tpu/y.py", "tools/x.py"]
+
+
+# -- r14 flight-recorder fixtures ----------------------------------------------
+
+
+def test_fault_site_accepts_journal_dump_site(tmp_path):
+    """The r14 flight-recorder dump boundary: ``journal.dump`` is in the
+    documented vocabulary (recovery: a failed dump is counted and
+    absorbed — the journal is best-effort), so a production module
+    carrying the site passes lint."""
+    findings = _run_pass(
+        _fault_site_pass(),
+        """
+        from fluidframework_tpu.testing.faults import inject_fault
+
+        @inject_fault("journal.dump")
+        def write_dump(path, payload):
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(payload)
+        """,
+        tmp_path,
+    )
+    assert findings == []
+
+
+def test_fault_site_flags_unregistered_journal_site(tmp_path):
+    """The r14 regression shape: a second journal boundary (e.g. an
+    upload site) added off-vocabulary must fail lint — the absorb
+    contract only exists if the site is documented."""
+    findings = _run_pass(
+        _fault_site_pass(),
+        """
+        from fluidframework_tpu.testing.faults import inject_fault
+
+        @inject_fault("journal.upload")
+        def upload_dump(path):
+            return path
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "unknown injection site" in findings[0].message
+
+
+def test_host_sync_flags_journal_producer_bare_transfer(tmp_path):
+    """The flight recorder's zero-readback contract: the journal
+    consumes HOST state only — the existing one-boxcar-stale scan and
+    /metrics scrape data. A journal producer that runs its OWN
+    device→host transfer to enrich an event is a new readback on the
+    serving path; the fixture proves the host-sync pass fails it bare
+    (and there is deliberately no blessed pragma shape for it: the fix
+    is to consume already-transferred data, not to annotate)."""
+    _, HostSync, *_ = _tools()
+    findings = _run_pass(
+        HostSync,
+        """
+        import numpy as np
+
+        def journal_device_err(pool, journal):
+            # WRONG: pulls the err lane synchronously just to journal it
+            err = np.asarray(pool.state.err)
+            journal.record("device.err", err_docs=int((err != 0).sum()))
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "device→host" in findings[0].message

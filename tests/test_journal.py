@@ -1,0 +1,480 @@
+"""Flight recorder (telemetry/journal.py): ring semantics, lineage
+reconstruction, replica-deterministic /debugz, auto-dump triggers, and
+the zero-cost-disabled / zero-readback contracts.
+
+The r14 acceptance bar: ``journal.lineage(doc, seq)`` reconstructs a
+sampled op's full stage path submit → admit → ticket → append → stage →
+dispatch → commit → broadcast end-to-end over a real websocket, and a
+chaos run with an injected crash auto-dumps a file carrying that op's
+lineage plus the injection event — with ZERO new device readbacks and
+nothing allocated while disabled.
+"""
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.models.shared_string import SharedString
+from fluidframework_tpu.protocol.constants import (
+    F_ARG,
+    F_LEN,
+    F_REF,
+    F_SEQ,
+    F_TYPE,
+    OP_INSERT,
+    OP_WIDTH,
+)
+from fluidframework_tpu.protocol.opframe import OpFrame, SeqFrame
+from fluidframework_tpu.runtime.container import ContainerRuntime
+from fluidframework_tpu.service.device_backend import DeviceFleetBackend
+from fluidframework_tpu.service.pipeline import PipelineFluidService
+from fluidframework_tpu.telemetry import journal, metrics
+from fluidframework_tpu.testing import faults
+
+MINT = 1 << 14  # shared_string._MINT_STRIDE
+
+
+@pytest.fixture(autouse=True)
+def _clean_journal():
+    journal.enable()
+    journal.reset()
+    journal.JOURNAL.dump_dir = None
+    faults.reset()
+    metrics.REGISTRY.reset()
+    yield
+    faults.reset()
+    journal.enable()
+    journal.reset()
+    journal.JOURNAL.dump_dir = None
+    metrics.REGISTRY.reset()
+
+
+# ---------------------------------------------------------------------------
+# Ring primitives
+
+
+def test_ring_bound_eviction_order():
+    """The ring is bounded and evicts OLDEST-first: after overflow the
+    surviving ids are the contiguous tail, and the eviction count is
+    visible (seen - len)."""
+    j = journal.Journal(capacity=16)
+    for i in range(21):
+        j.record("pressure", score=i)
+    evs = j.events()
+    assert len(evs) == 16
+    assert [e.eid for e in evs] == list(range(5, 21))
+    assert j.seen == 21 and j.evicted == 5
+    assert "evicted=5" in j.render().splitlines()[0]
+
+
+def test_unknown_event_kind_raises():
+    with pytest.raises(ValueError):
+        journal.JOURNAL.record("not.a.kind")
+
+
+def test_debugz_render_is_replica_deterministic():
+    """Two replicas observing the SAME events render byte-equal /debugz
+    text: event ids are logical, wall timestamps are excluded (they live
+    only in the file-dump form), details render in sorted order."""
+    a, b = journal.Journal(capacity=64), journal.Journal(capacity=64)
+    for j, delay in ((a, 0.0), (b, 0.02)):
+        j.record("frame.submit", doc="d", client=3, csn=1, csn_hi=4, n=4)
+        if delay:
+            time.sleep(delay)  # wall clocks diverge; renders must not
+        j.record(
+            "frame.ticket", doc="d", seq=10, seq_hi=13, csn=1, csn_hi=4,
+            client=3,
+        )
+        j.record("device.stage", spans=(("d", 10, 13),), rows=4)
+        j.record("pressure", ring_frac=0.5, queue_frac=0.25, feed_lag_ms=1.5)
+    assert a.render() == b.render()
+    # The dump form DOES carry timestamps (the post-mortem needs them);
+    # the deterministic render never does.
+    assert '"ts":' in a.dump_payload("x")
+    for ev in a.events():
+        assert str(round(ev.ts, 6)) not in a.render()
+
+
+# ---------------------------------------------------------------------------
+# Lineage reconstruction (pipeline level)
+
+
+def _one_frame(conn, svc, doc, k=3, c0=1):
+    origs = [conn.conn_no * MINT + c0 + j for j in range(k)]
+    return OpFrame.build(
+        "s", ["ins"] * k, [0] * k, origs, ["x"] * k, csn0=c0,
+        ref=svc.doc_head(doc),
+    )
+
+
+LINEAGE_PATH = {
+    "frame.submit", "admission.admit", "frame.ticket", "log.append",
+    "device.stage", "device.dispatch", "device.commit", "broadcast",
+}
+
+
+def test_lineage_device_committed_op():
+    """The full path for an op that rode the device: submit → admit →
+    ticket → append → stage → dispatch → commit → broadcast, in record
+    order."""
+    svc = PipelineFluidService(n_partitions=2)
+    conn = svc.connect("lin-doc")
+    head = svc.doc_head("lin-doc")
+    conn.submit_frame(_one_frame(conn, svc, "lin-doc"))
+    svc.pump()
+    svc.flush_device()
+    lin = journal.lineage("lin-doc", head + 2)  # mid-frame op
+    kinds = [e.kind for e in lin]
+    assert LINEAGE_PATH <= set(kinds), kinds
+    # Record order is monotone and the pre-sequencing half precedes the
+    # ticket that resolved the identity join.
+    assert [e.eid for e in lin] == sorted(e.eid for e in lin)
+    assert kinds.index("frame.submit") < kinds.index("frame.ticket")
+    assert kinds.index("device.stage") < kinds.index("device.commit")
+
+
+def test_lineage_dup_nacked_op():
+    """A replayed frame dropped whole by deli's dedup leaves a
+    ``frame.nack(reason=dup)`` entry correlated by (client, csn) — the
+    resubmit's death is visible in the op's lineage, not silent."""
+    svc = PipelineFluidService(n_partitions=2)
+    conn = svc.connect("dup-doc")
+    head = svc.doc_head("dup-doc")
+    frame = _one_frame(conn, svc, "dup-doc")
+    conn.submit_frame(frame)
+    conn.submit_frame(frame)  # same csn range: whole-frame duplicate
+    svc.pump()
+    svc.flush_device()
+    lin = journal.lineage("dup-doc", head + 1)
+    nacks = [e for e in lin if e.kind == "frame.nack"]
+    assert len(nacks) == 1
+    assert dict(nacks[0].detail)["reason"] == "dup"
+    assert LINEAGE_PATH <= {e.kind for e in lin}
+
+
+# ---------------------------------------------------------------------------
+# Zero cost disabled / zero readbacks enabled
+
+
+def test_zero_alloc_when_disabled(monkeypatch):
+    """Disabled, the journal allocates NOTHING: every producer site is
+    one predicate; the counting shim pins that no record call reaches
+    the ring through a full pipeline workload."""
+    calls = []
+    orig = journal.Journal.record
+
+    def counting(self, kind, **kw):
+        calls.append(kind)
+        return orig(self, kind, **kw)
+
+    monkeypatch.setattr(journal.Journal, "record", counting)
+    journal.disable()
+    svc = PipelineFluidService(n_partitions=2)
+    conn = svc.connect("off-doc")
+    conn.submit_frame(_one_frame(conn, svc, "off-doc"))
+    svc.pump()
+    svc.flush_device()
+    assert calls == []
+    assert journal.JOURNAL.seen == 0
+    journal.enable()
+    conn.submit_frame(_one_frame(conn, svc, "off-doc", c0=4))
+    svc.pump()
+    svc.flush_device()
+    assert calls, "re-enabled journal must record again"
+
+
+def test_journal_adds_zero_device_readbacks(monkeypatch):
+    """The zero-readback contract: journal-on performs EXACTLY the same
+    device→host transfers as journal-off — the commit events consume the
+    pump's existing one-boxcar-stale scan, never their own pull."""
+    from fluidframework_tpu.parallel import fleet as fleet_mod
+    from fluidframework_tpu.service import device_backend as db_mod
+
+    def run() -> int:
+        be = DeviceFleetBackend(
+            capacity=128, max_batch=1 << 20, pump_mode=True
+        )
+        ar = np.arange(4, dtype=np.int32)
+        calls = []
+        real = np.asarray
+
+        class _CountingNp:
+            def __getattr__(self, name):
+                return getattr(np, name)
+
+            @staticmethod
+            def asarray(*a, **kw):
+                calls.append(1)
+                return real(*a, **kw)
+
+            @staticmethod
+            def array(*a, **kw):
+                calls.append(1)
+                return np.array(*a, **kw)
+
+        monkeypatch.setattr(fleet_mod, "np", _CountingNp())
+        monkeypatch.setattr(db_mod, "np", _CountingNp())
+        try:
+            for r in range(3):
+                for i in range(4):
+                    rows = np.zeros((4, OP_WIDTH), np.int32)
+                    rows[:, F_TYPE] = OP_INSERT
+                    rows[:, F_LEN] = 1
+                    rows[:, F_SEQ] = r * 4 + 1 + ar
+                    rows[:, F_REF] = r * 4
+                    rows[:, F_ARG] = r * 4 + 1 + ar
+                    be.enqueue_frame(
+                        f"d{i}", SeqFrame("s", 0, 1, rows, (), 0.0)
+                    )
+                be.flush()
+            be.pump_drain()
+        finally:
+            monkeypatch.setattr(fleet_mod, "np", np)
+            monkeypatch.setattr(db_mod, "np", np)
+        return len(calls)
+
+    journal.disable()
+    off = run()
+    journal.enable()
+    journal.reset()
+    on = run()
+    assert on == off, f"journal added readbacks: on={on} off={off}"
+    assert journal.JOURNAL.seen > 0
+
+
+# ---------------------------------------------------------------------------
+# Auto-dump triggers
+
+
+def test_chaos_crash_auto_dumps_lineage_and_injection(tmp_path):
+    """The acceptance cell: an injected crash at the dispatch boundary
+    lands an auto-dump file carrying (a) the injection event and (b) the
+    in-flight op's lineage entries — 'bit-exact assertion failed'
+    becomes a diagnosable event stream."""
+    svc = PipelineFluidService(n_partitions=2)
+    conn = svc.connect("cr-doc")
+    head = svc.doc_head("cr-doc")
+    journal.configure(dump_dir=str(tmp_path))
+    faults.arm("pump.dispatch", faults.CrashAt("after"))
+    try:
+        conn.submit_frame(_one_frame(conn, svc, "cr-doc"))
+    except faults.InjectedFault:
+        pass  # the harness plays the restart supervisor
+    faults.disarm()
+    svc.pump()
+    svc.flush_device()
+    files = sorted(tmp_path.glob("journal-*.json"))
+    assert files, "fatal dispatch crash must auto-dump"
+    doc = json.loads(files[0].read_text())
+    assert doc["reason"] == "pump.dispatch-fatal"
+    kinds = [e["kind"] for e in doc["events"]]
+    assert "fault.injected" in kinds
+    inj = next(e for e in doc["events"] if e["kind"] == "fault.injected")
+    assert inj["detail"] == {"site": "pump.dispatch", "fault": "crash_after"}
+    # The crashed op's lineage up to the crash is in the dump: its
+    # submit, ticket, append, and the staged boxcar covering its seqs.
+    assert "frame.ticket" in kinds and "device.stage" in kinds
+    staged = next(e for e in doc["events"] if e["kind"] == "device.stage")
+    assert any(d == "cr-doc" and lo <= head + 1 <= hi
+               for d, lo, hi in staged["spans"])
+    # And a dumps counter moved — never a silent file write.
+    assert metrics.REGISTRY.get("journal_dumps_total").value(
+        reason="pump.dispatch-fatal"
+    ) == 1
+
+
+def test_err_lane_trip_auto_dumps(tmp_path):
+    """An err-lane trip (channel over device capacity) journals the
+    channel and auto-dumps."""
+    svc = PipelineFluidService(
+        n_partitions=2, device_capacity=8, device_max_capacity=8
+    )
+    journal.configure(dump_dir=str(tmp_path))
+    conn = svc.connect("err-doc")
+    k = 24  # blows past the 8-slot top tier
+    frame = OpFrame.build(
+        "s", ["ins"] * k, [0] * k,
+        [conn.conn_no * MINT + 1 + j for j in range(k)], ["x"] * k,
+        csn0=1, ref=svc.doc_head("err-doc"),
+    )
+    conn.submit_frame(frame)
+    svc.pump()
+    svc.flush_device()
+    evs = [e for e in journal.JOURNAL.events() if e.kind == "device.err"]
+    assert evs and evs[0].doc == "err-doc"
+    files = sorted(tmp_path.glob("journal-*err_lane*.json"))
+    assert files, "err-lane trip must auto-dump"
+
+
+def test_dump_budget_bounds_files(tmp_path):
+    journal.configure(dump_dir=str(tmp_path), max_dumps=2)
+    for i in range(5):
+        journal.auto_dump("err_lane")
+    assert len(list(tmp_path.glob("journal-*.json"))) == 2
+
+
+def test_failed_dump_is_counted_and_absorbed(tmp_path):
+    """The ``journal.dump`` site's contract: a failed dump write is
+    counted (retry_attempts_total{journal.dump,fallback}) and absorbed —
+    never raised into the serving path — and the ring still holds the
+    events for /debugz."""
+    journal.configure(dump_dir=str(tmp_path))
+    journal.record("device.err", doc="d", addr="s")
+    faults.arm("journal.dump", faults.FailN(1))
+    assert journal.auto_dump("err_lane") is None
+    faults.disarm()
+    c = metrics.REGISTRY.get("retry_attempts_total")
+    assert c.value(site="journal.dump", outcome="fallback") == 1
+    assert list(tmp_path.glob("journal-*.json")) == []
+    assert "device.err" in journal.render()
+    # Budget not burned pointlessly on top of the failure is not
+    # promised; what IS promised: the next dump attempt still works.
+    assert journal.auto_dump("err_lane") is not None
+
+
+def test_retry_exhaustion_auto_dumps(tmp_path):
+    """An exhausted retry budget at any site fires the auto-dump."""
+    from fluidframework_tpu.service.retry import RetryPolicy, call_with_retry
+
+    journal.configure(dump_dir=str(tmp_path))
+
+    def always():
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        call_with_retry(
+            "queue.send", always, policy=RetryPolicy(max_attempts=2),
+            sleep=lambda _d: None,
+        )
+    files = list(tmp_path.glob("journal-*.json"))
+    assert len(files) == 1
+    doc = json.loads(files[0].read_text())
+    assert doc["reason"] == "queue.send-exhausted"
+    kinds = [e["kind"] for e in doc["events"]]
+    assert kinds.count("retry.outcome") >= 2  # the retry + the exhaustion
+
+
+# ---------------------------------------------------------------------------
+# /debugz surfaces
+
+
+def test_debugz_over_network_server_and_shed_exemption():
+    """GET /debugz on the front door returns the deterministic journal
+    render, and stays reachable at REFUSE_CONNECTIONS exactly like
+    /metrics (the post-mortem surface must survive the overload it
+    documents) while ordinary reads are refused."""
+    from fluidframework_tpu.service.admission import Tier
+    from fluidframework_tpu.service.network_server import FluidNetworkServer
+
+    svc = PipelineFluidService(n_partitions=2)
+    conn = svc.connect("dz-doc")
+    conn.submit_frame(_one_frame(conn, svc, "dz-doc"))
+    svc.pump()
+    srv = FluidNetworkServer(service=svc)
+    srv.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debugz", timeout=5
+        ).read().decode()
+        assert body.startswith("# flight-recorder")
+        assert "frame.ticket doc=dz-doc" in body
+        assert body == journal.render()  # replica-deterministic bytes
+        svc.overload.force(Tier.REFUSE_CONNECTIONS)
+        body2 = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debugz", timeout=5
+        ).read().decode()
+        assert body2.startswith("# flight-recorder")
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/deltas/dz-doc", timeout=5
+            )
+        svc.overload.force(None)
+    finally:
+        srv.stop()
+
+
+def test_debugz_on_store_node():
+    from fluidframework_tpu.service.store_server import StoreServer
+
+    journal.record("log.append", doc="sn-doc", seq=7)
+    node = StoreServer(port=0, n_partitions=2).serve_background()
+    try:
+        with socket.create_connection((node.host, node.port), timeout=5) as s:
+            s.sendall(b"GET /debugz HTTP/1.1\r\nHost: x\r\n\r\n")
+            buf = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+        head, _, body = buf.partition(b"\r\n\r\n")
+        assert head.startswith(b"HTTP/1.1 200 OK")
+        text = body.decode()
+        assert text.startswith("# flight-recorder")
+        assert "log.append doc=sn-doc seq=7" in text
+    finally:
+        node.close()
+
+
+# ---------------------------------------------------------------------------
+# The acceptance bar: lineage end-to-end over a real websocket
+
+
+def test_lineage_end_to_end_over_real_websocket():
+    """A sampled op submitted by a real websocket client reconstructs
+    its full stage path from the journal — and the /debugz surface
+    serves the same ring the lineage came from."""
+    from fluidframework_tpu.drivers.network_driver import NetworkFluidService
+    from fluidframework_tpu.service.network_server import FluidNetworkServer
+
+    svc = PipelineFluidService(n_partitions=2, messages_per_trace=1)
+    srv = FluidNetworkServer(service=svc)
+    srv.start()
+    try:
+        rts = [
+            ContainerRuntime(
+                NetworkFluidService("127.0.0.1", srv.port), "ws-doc",
+                channels=(SharedString("s"),),
+            )
+            for _ in range(2)
+        ]
+        for i, rt in enumerate(rts):
+            ch = rt.get_channel("s")
+            for j in range(4):
+                ch.insert_text(0, chr(97 + (i * 4 + j) % 26))
+        deadline = time.monotonic() + 10
+        for rt in rts:
+            rt.flush()
+        quiet = 0
+        while time.monotonic() < deadline and quiet < 3:
+            if any(rt.process_incoming() for rt in rts):
+                quiet = 0
+            else:
+                quiet += 1
+                time.sleep(0.02)
+        svc.flush_device()
+        assert srv.frames_received >= 2, "frame wire not taken"
+        texts = {rt.get_channel("s").get_text() for rt in rts}
+        assert len(texts) == 1
+        # Pick a sequenced op off a ticket event and reconstruct it.
+        tickets = [
+            e for e in journal.JOURNAL.events()
+            if e.kind == "frame.ticket" and e.doc == "ws-doc"
+        ]
+        assert tickets
+        seq = tickets[-1].seq_hi
+        kinds = {e.kind for e in journal.lineage("ws-doc", seq)}
+        assert LINEAGE_PATH <= kinds, kinds
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debugz", timeout=5
+        ).read().decode()
+        assert f"frame.ticket doc=ws-doc" in body
+        for rt in rts:
+            rt.disconnect()
+    finally:
+        srv.stop()

@@ -681,3 +681,86 @@ def test_tree_device_commits_reach_registry():
     assert em.device_commits == len(log)
     ctr = metrics.REGISTRY.get("tree_ingest_commits_total")
     assert ctr.value(path="device", reason="") == len(log)
+
+
+# ---------------------------------------------------------------------------
+# r14 satellites: trace-drop accounting + stage-span quantiles
+
+
+def test_trace_book_drop_accounting_reaches_registry():
+    """Traces that age out of the ledger (max_live eviction) used to
+    vanish into a host-side int; the registry now counts them
+    (trace_frames_dropped_total{reason="max_live"}) — a regression here
+    would silently re-blind the sampled-trace loss signal."""
+    reg = MetricsRegistry()
+    book = tracing.TraceBook(max_live=4, registry=reg)
+    for _ in range(10):
+        book.open()
+    assert book.dropped == 6
+    ctr = reg.get("trace_frames_dropped_total")
+    assert ctr is not None
+    assert ctr.value(reason="max_live") == 6
+    # The default-registry TraceBook feeds the process registry.
+    book2 = tracing.TraceBook(max_live=2)
+    for _ in range(3):
+        book2.open()
+    assert metrics.trace_dropped_counter().value(reason="max_live") == 1
+
+
+def test_stage_span_summary_quantiles():
+    """p50/p95/p99 estimates from the existing fixed-bucket histogram:
+    interpolated within the bucket, ordered, bounded by the bucket edges
+    — and the default (mean-only) shape is unchanged."""
+    reg = MetricsRegistry()
+    hist = reg.histogram(
+        "serving_stage_ms", "spans", labelnames=("stage",)
+    )
+    # 100 observations spread 1..100 ms for one stage; a tight cluster
+    # for another.
+    for v in range(1, 101):
+        hist.observe(float(v), stage="deli")
+    for _ in range(10):
+        hist.observe(0.05, stage="broadcast")
+    # Default shape: plain means (the r9 artifact contract).
+    means = metrics.stage_span_summary(registry=reg)
+    assert means["deli"] == pytest.approx(50.5, abs=0.01)
+    assert isinstance(means["deli"], float)
+    q = metrics.stage_span_summary(
+        registry=reg, quantiles=(0.5, 0.95, 0.99)
+    )
+    deli = q["deli"]
+    assert set(deli) == {"mean", "p50", "p95", "p99"}
+    assert deli["mean"] == means["deli"]
+    # Ordered and inside the right buckets: the median of 1..100 falls
+    # in the (25, 50] bucket, the p99 in the (50, 100] bucket.
+    assert deli["p50"] <= deli["p95"] <= deli["p99"]
+    assert 25.0 < deli["p50"] <= 50.0
+    assert 50.0 < deli["p99"] <= 100.0
+    # A cluster entirely inside the first bucket stays there.
+    assert q["broadcast"]["p99"] <= 0.1
+
+
+def test_quantile_interpolation_exact_cases():
+    """The interpolation arithmetic, pinned: counts concentrated in one
+    bucket interpolate linearly across it; ranks past the last finite
+    bucket clamp to its bound (the honest fixed-bucket answer)."""
+    buckets = (1.0, 2.0, 4.0)
+    # 4 observations in the (1, 2] bucket: p50 lands mid-bucket.
+    assert metrics._bucket_quantile(buckets, [0, 4, 0, 0], 0.5) == (
+        pytest.approx(1.5)
+    )
+    # Empty histogram: 0.
+    assert metrics._bucket_quantile(buckets, [0, 0, 0, 0], 0.99) == 0.0
+    # Everything in +Inf: clamp to the last finite bound.
+    assert metrics._bucket_quantile(buckets, [0, 0, 0, 5], 0.5) == 4.0
+
+
+def test_bench_p99_rides_the_spans_histogram():
+    """The bench artifact key shape: serving_stage_p99_ms maps stage ->
+    p99 from the same histogram the means come from."""
+    metrics.observe_stage_spans({"deli_ms": 3.0, "total_ms": 9.0})
+    metrics.observe_stage_spans({"deli_ms": 4.0, "total_ms": 12.0})
+    q = metrics.stage_span_summary(quantiles=(0.99,))
+    p99 = {stage: row["p99"] for stage, row in q.items()}
+    assert set(p99) == {"deli", "total"}
+    assert p99["deli"] <= 5.0 and p99["total"] <= 25.0

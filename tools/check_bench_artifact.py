@@ -37,7 +37,10 @@ from typing import List, Tuple
 # latency under continuous feed — in r12; the overload-envelope pair —
 # the goodput curve at 0.5x/1x/2x admission capacity (linear-not-cliff
 # asserted in-bench, gapless seq runs across every tier transition) and
-# the counted load-shedding tier transitions — in r13.
+# the counted load-shedding tier transitions — in r13; the
+# flight-recorder pair — the measured journal-on/journal-off serving
+# overhead (asserted ≤ 0.05 in-bench) and the per-stage p99 tail next
+# to the r9 means — in r14.
 REQUIRED = (
     ("pipeline_serving_ops_per_sec", 6),
     ("deli_scribe_e2e_ops_per_sec", 6),
@@ -52,6 +55,8 @@ REQUIRED = (
     ("serving_feed_latency_ms", 12),
     ("overload_goodput_curve", 13),
     ("serving_overload_tier_transitions", 13),
+    ("journal_overhead_frac", 14),
+    ("serving_stage_p99_ms", 14),
 )
 # Artifacts up to round 5 predate every gated metric.
 BASELINE_ROUND = 5
