@@ -298,6 +298,28 @@ def _doc_gather(state: SegmentState, slot):
     return lanes, scal
 
 
+@jax.jit
+def _docs_gather(state: SegmentState, slots):
+    """N documents' lanes + scalars gathered ON DEVICE as one flat
+    ``[n, L*S + 5]``-row vector (r15, the read-path fan-out): the
+    ``telemetry_slice`` one-readback pattern generalized to snapshot
+    reads — per-pool results concatenate into ONE device vector so N
+    pending readers cost ONE host transfer, not N ``_doc_gather``
+    round trips. ``slots`` pads to a pow2 bucket (padding re-gathers
+    slot 0 and is discarded at finish) so compiled shapes stay
+    logarithmic in reader count."""
+    n = slots.shape[0]
+    lanes = jnp.stack(
+        [getattr(state, k)[slots] for k in SEGMENT_LANES], axis=1
+    )  # [n, L, S]
+    scal = jnp.stack(
+        [getattr(state, s)[slots] for s in _SCALARS], axis=1
+    )  # [n, 5]
+    return jnp.concatenate(
+        [lanes.reshape(n, -1), scal], axis=1
+    ).reshape(-1)
+
+
 def _pallas_step(state: SegmentState, ops) -> SegmentState:
     """Pallas engine for fleet pools: grid-of-blocks compilation keeps the
     per-program unit small — the monolithic XLA scan at 16k-slot shapes
@@ -991,4 +1013,84 @@ class DocFleet:
         return SegmentState(
             **{k: lanes[i] for i, k in enumerate(SEGMENT_LANES)},
             **{s: scal[i] for i, s in enumerate(_SCALARS)},
+        )
+
+    def doc_states_start(self, docs: List[int]):
+        """The device half of one batched multi-doc gather, NO readback
+        (r15 read-path fan-out — the ``_telemetry_device`` split applied
+        to snapshot reads): per-pool jitted :func:`_docs_gather` results
+        concatenated into one flat device vector, plus the layout to
+        split it. Slot vectors pad to pow2 buckets (padding re-gathers
+        slot 0, discarded at finish) so the compiled-shape set stays
+        logarithmic in reader count. Reads live placement state, so it
+        must run on the serving thread; the returned device vector is a
+        concrete array safe to transfer from any thread."""
+        _, slot_arr = self._place_arrays()
+        by_cap: Dict[int, List[int]] = {}
+        for d in docs:
+            place = self.placement[d]
+            if place is None:
+                raise KeyError(
+                    f"doc {d} evicted from the fleet (sharded overflow)"
+                )
+            by_cap.setdefault(place[0], []).append(int(d))
+        devs = []
+        layout: List[Tuple[int, List[int], int]] = []
+        for cap in sorted(by_cap):
+            pool = self.pools[cap]
+            members = by_cap[cap]
+            pad = _pow2_at_least(len(members))
+            slots = np.zeros(pad, np.int32)
+            slots[: len(members)] = slot_arr[
+                np.asarray(members, np.int64)
+            ]
+            devs.append(_docs_gather(pool.state, jnp.asarray(slots)))
+            layout.append((cap, members, pad))
+        dev = jnp.concatenate(devs) if len(devs) > 1 else devs[0]
+        return dev, layout
+
+    @staticmethod
+    def doc_states_transfer(dev) -> np.ndarray:
+        """The blocking device→host half of one batched gather — ``dev``
+        is an immutable concrete array, so async servers may run THIS
+        half (and only this half) off the serving thread (the
+        ``_telemetry_readback`` rule)."""
+        return np.asarray(dev)  # graftlint: readback(the ONE batched multi-doc gather readback — N snapshot reads, one transfer; telemetry/README.md read-tier contract)
+
+    @staticmethod
+    def doc_states_finish(
+        host: np.ndarray, layout
+    ) -> Dict[int, SegmentState]:
+        """Split one batched-gather readback into per-doc states (doc id
+        -> :class:`SegmentState`), bit-identical to per-doc
+        :meth:`doc_state` — the parity contract tests pin."""
+        out: Dict[int, SegmentState] = {}
+        nl = len(SEGMENT_LANES)
+        ns = len(_SCALARS)
+        o = 0
+        for cap, members, pad in layout:
+            row = nl * cap + ns
+            block = host[o: o + pad * row].reshape(pad, row)
+            o += pad * row
+            for i, d in enumerate(members):
+                lanes = block[i, : nl * cap].reshape(nl, cap)
+                scal = block[i, nl * cap:]
+                out[d] = SegmentState(
+                    **{k: lanes[j] for j, k in enumerate(SEGMENT_LANES)},
+                    **{s: scal[j] for j, s in enumerate(_SCALARS)},
+                )
+        return out
+
+    def doc_states(self, docs: List[int]) -> Dict[int, SegmentState]:
+        """N documents' full states in EXACTLY ONE batched device→host
+        readback: one multi-doc gather per pool concatenated on device,
+        one transfer for everything — N independent ``doc_state`` calls
+        pay N round trips for the same bytes. Serves batched snapshot
+        reads (DeviceFleetBackend.read path; amortization is the
+        ``reads_per_device_dispatch`` counter)."""
+        if not docs:
+            return {}
+        dev, layout = self.doc_states_start(docs)
+        return self.doc_states_finish(
+            self.doc_states_transfer(dev), layout
         )

@@ -150,6 +150,50 @@ def _mesh_pallas_compact(mesh: Mesh, axis: str, interpret: bool):
     )
 
 
+# Batched multi-doc slice for the packed (pallas) layout: N docs' table
+# planes + scalar rows gathered on device in one jitted call — the
+# fleet.py ``_docs_gather`` analog for the packed fleet (r15 read path).
+_docs_slice_packed = jax.jit(lambda tables, scalars, docs: (
+    tables[:, docs], scalars[docs]
+))
+
+
+def unpack_packed_doc_states(
+    host: np.ndarray, docs, s: int, pad: int = 0
+) -> dict:
+    """Split one packed-layout multi-doc readback — ``[L, pad, S]`` lane
+    planes followed by ``[pad, N_SCALARS]`` scalar rows, flattened into
+    one vector — into per-doc SegmentStates (``pad`` rows beyond
+    ``len(docs)`` are gather padding, discarded). THE one unpack for
+    the packed gather layout, shared by ``DocShard.doc_states``
+    (pallas) and ``TpuFleetService.doc_states`` so the bit-parity
+    contract cannot diverge between backends."""
+    from fluidframework_tpu.ops.pallas_kernel import (
+        SC_COUNT,
+        SC_CUR_SEQ,
+        SC_ERR,
+        SC_MIN_SEQ,
+        SC_SELF,
+    )
+    from fluidframework_tpu.ops.segment_state import SEGMENT_LANES
+
+    pad = pad or len(docs)
+    nl = len(SEGMENT_LANES)
+    lanes = host[: nl * pad * s].reshape(nl, pad, s)
+    scal = host[nl * pad * s:].reshape(pad, -1)
+    return {
+        d: SegmentState(
+            **{k: lanes[i, j] for i, k in enumerate(SEGMENT_LANES)},
+            count=scal[j, SC_COUNT],
+            min_seq=scal[j, SC_MIN_SEQ],
+            cur_seq=scal[j, SC_CUR_SEQ],
+            self_client=scal[j, SC_SELF],
+            err=scal[j, SC_ERR],
+        )
+        for j, d in enumerate(docs)
+    }
+
+
 class DocShard:
     """A mesh-resident fleet of documents — the compute backend the service
     layer feeds with sequenced op batches (the ``TpuDeliLambda`` target).
@@ -259,6 +303,43 @@ class DocShard:
             )
         else:
             self.state = batched_compact(self.state)
+
+    def doc_states(self, docs) -> dict:
+        """N documents' full states in ONE batched device→host readback
+        (r15 read-path fan-out — the ``telemetry_slice`` one-readback
+        rule applied to snapshot reads): the per-doc gather stacks on
+        device and one flat transfer serves every requested doc, instead
+        of N per-doc slice round trips. Returns doc id ->
+        :class:`SegmentState`, bit-identical to a per-doc slice."""
+        from fluidframework_tpu.utils import pow2_at_least
+
+        docs = [int(d) for d in docs]
+        if not docs:
+            return {}
+        # Pow2-pad the index (padding re-gathers doc 0, discarded at
+        # unpack) so compiled gather shapes stay logarithmic in reader
+        # count — the DocFleet.doc_states_start rule.
+        pad = pow2_at_least(len(docs))
+        idx_np = np.zeros(pad, np.int32)
+        idx_np[: len(docs)] = docs
+        idx = jnp.asarray(idx_np)
+        if self.backend == "pallas":
+            lanes_dev, scal_dev = _docs_slice_packed(
+                self._tables, self._scalars, idx
+            )
+            host = np.asarray(  # graftlint: readback(the ONE batched multi-doc gather readback — N snapshot reads, one transfer)
+                jnp.concatenate(
+                    [lanes_dev.reshape(-1), scal_dev.reshape(-1)]
+                )
+            )
+            return unpack_packed_doc_states(
+                host, docs, int(lanes_dev.shape[-1]), pad=pad
+            )
+        from fluidframework_tpu.parallel.fleet import DocFleet, _docs_gather
+
+        host = np.asarray(_docs_gather(self.state, idx))  # graftlint: readback(the ONE batched multi-doc gather readback — N snapshot reads, one transfer)
+        s = int(self.state.kind.shape[-1])
+        return DocFleet.doc_states_finish(host, [(s, docs, pad)])
 
     def telemetry_slice(self) -> np.ndarray:
         """[n_devices, len(fleet.TELEMETRY_COLS)] per-mesh-shard health

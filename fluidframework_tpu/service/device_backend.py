@@ -192,6 +192,14 @@ class DeviceFleetBackend:
         self._errored: set = set()  # fleet ids already reported
         self._unreported: List[ChannelKey] = []
         self.ops_applied = 0
+        # The read tier's amortization counters (r15): snapshot reads
+        # served vs device gather dispatches — reads_per_device_dispatch
+        # is the batching win the bench artifact gates on, and
+        # read_gather_fallbacks counts faulted batched gathers served
+        # through per-doc host gathers instead (never a failed read).
+        self.reads_served = 0
+        self.read_gathers = 0
+        self.read_gather_fallbacks = 0
         # Where flush wall goes (host staging vs upload + dispatch):
         # last_flush_breakdown is the most recent flush; flush_totals
         # accumulates monotonically (benches diff it across rounds —
@@ -1192,24 +1200,120 @@ class DeviceFleetBackend:
 
     # -- the read path ---------------------------------------------------------
 
-    def text(self, doc_id: str, address: str) -> str:
-        """Serve the channel's current text from device state."""
-        key = (doc_id, address)
-        if key not in self._index:
-            return ""
-        self.flush()
-        state = self._doc_state(self._index[key])
+    def read_start(self, keys: List[ChannelKey]) -> dict:
+        """The serving-thread half of one batched snapshot read (r15
+        read-path fan-out): resolve channel keys to fleet slots, gather
+        sharded-overflow docs on host (rare — they live outside the
+        pools), and start the fleet's batched device gather. Returns a
+        token whose ``dev`` vector an async server may transfer OFF the
+        serving thread (:meth:`read_transfer`) before
+        :meth:`read_finish` — the telemetry-scrape split applied to
+        reads. A faulted gather (the ``read.gather`` site) falls back to
+        per-doc host gathers HERE, counted, never silent."""
+        order: List[Tuple[ChannelKey, int]] = [
+            (key, self._index[key]) for key in keys
+        ]
+        sharded = {
+            idx: self._sharded[idx].to_single()
+            for _key, idx in order if idx in self._sharded
+        }
+        fleet_idxs = [
+            idx for _key, idx in order if idx not in sharded
+        ]
+        dev = layout = fallback = None
+        if fleet_idxs:
+            try:
+                dev, layout = self._gather_start(fleet_idxs)
+            except faults.InjectedFault:
+                # Batched gather crashed: serve this round through
+                # per-doc host gathers — N transfers instead of one,
+                # never a failed read. Counted at both registries (the
+                # retry family and the amortization denominator).
+                retry.retry_counter().inc(
+                    site="read.gather", outcome="fallback"
+                )
+                if journal._ON:
+                    journal.record(
+                        "retry.outcome", site="read.gather",
+                        outcome="fallback",
+                    )
+                self.read_gather_fallbacks += 1
+                self.read_gathers += len(fleet_idxs)
+                fallback = {
+                    idx: self.fleet.doc_state(idx) for idx in fleet_idxs
+                }
+            else:
+                self.read_gathers += 1
+        return {
+            "order": order, "sharded": sharded, "dev": dev,
+            "layout": layout, "fallback": fallback,
+        }
+
+    @inject_fault("read.gather")
+    def _gather_start(self, idxs: List[int]):
+        """The injected device-dispatch half of one batched gather (NO
+        readback — the transfer half may run off-thread)."""
+        return self.fleet.doc_states_start(idxs)
+
+    @staticmethod
+    def read_transfer(dev) -> np.ndarray:
+        """The blocking device→host half of one read batch — safe off
+        the serving thread (the token's ``dev`` is an immutable concrete
+        array), so N REST readers cost the event loop zero device round
+        trips."""
+        return DocFleet.doc_states_transfer(dev)
+
+    def read_finish(
+        self, token: dict, host: Optional[np.ndarray] = None
+    ) -> Dict[ChannelKey, "object"]:
+        """Split one read batch into per-channel states (key ->
+        SegmentState) and advance the amortization counters
+        (``reads_served`` / ``read_gathers`` →
+        ``reads_per_device_dispatch``)."""
+        states: Dict[int, object] = {}
+        if token["fallback"] is not None:
+            states.update(token["fallback"])
+        elif token["dev"] is not None:
+            if host is None:
+                host = self.read_transfer(token["dev"])
+            states.update(
+                DocFleet.doc_states_finish(host, token["layout"])
+            )
+        states.update(token["sharded"])
+        self.reads_served += len(token["order"])
+        return {key: states[idx] for key, idx in token["order"]}
+
+    def doc_states(
+        self, keys: List[ChannelKey]
+    ) -> Dict[ChannelKey, "object"]:
+        """N channels' device states with ONE batched readback (the
+        ``telemetry_slice`` one-readback rule on the read path): the
+        deadline ticker collects N pending snapshot/read requests and
+        serves them all from one device dispatch — the amortization the
+        ``reads_per_device_dispatch`` counter reports. Sharded-overflow
+        docs gather host-side (they live outside the pools); a faulted
+        device gather falls back to per-doc host gathers (the
+        ``read.gather`` recovery contract)."""
+        if not keys:
+            return {}
+        return self.read_finish(self.read_start(keys))
+
+    @property
+    def reads_per_device_dispatch(self) -> float:
+        """Snapshot reads served per device gather dispatch — the read
+        tier's amortization headline (1.0 = no batching win; the bench
+        gate wants > 1 under concurrent load)."""
+        return self.reads_served / max(1, self.read_gathers)
+
+    def text_from_state(self, key: ChannelKey, state) -> str:
+        """Materialize one gathered state against the channel's payload
+        dict (the batched-read consumer half)."""
         return materialize(state, self.payloads[key])
 
-    def channel_summary(self, doc_id: str, address: str) -> Optional[dict]:
-        """Channel summary in the client ``summarize_core`` lane format,
-        read back from device (the device-scribe producer). Returns None
-        for unknown channels."""
-        key = (doc_id, address)
-        if key not in self._index:
-            return None
-        self.flush()
-        h = self._doc_state(self._index[key])
+    def summary_from_state(self, key: ChannelKey, h) -> dict:
+        """One gathered state in the client ``summarize_core`` lane
+        format (the batched-read consumer half of
+        :meth:`channel_summary`)."""
         n = int(h.count)
         self._since_a[self._index[key]] = 0
         return {
@@ -1223,6 +1327,26 @@ class DeviceFleetBackend:
             "payloads": dict(self.payloads[key]),
             "intervals": {},
         }
+
+    def text(self, doc_id: str, address: str) -> str:
+        """Serve the channel's current text from device state (a batch
+        of one through the batched read path, so the amortization
+        counters see every read)."""
+        key = (doc_id, address)
+        if key not in self._index:
+            return ""
+        self.flush()
+        return self.text_from_state(key, self.doc_states([key])[key])
+
+    def channel_summary(self, doc_id: str, address: str) -> Optional[dict]:
+        """Channel summary in the client ``summarize_core`` lane format,
+        read back from device (the device-scribe producer). Returns None
+        for unknown channels."""
+        key = (doc_id, address)
+        if key not in self._index:
+            return None
+        self.flush()
+        return self.summary_from_state(key, self.doc_states([key])[key])
 
     def dirty_channels(self, threshold: int = 1) -> List[ChannelKey]:
         """Channels with >= threshold ops applied since their last summary
@@ -1268,6 +1392,8 @@ class DeviceFleetBackend:
             "buffered_rows": self._buffered_rows,
             "channels": len(self._keys),
             "sharded_docs": len(self._sharded),
+            "reads_served": self.reads_served,
+            "read_gathers": self.read_gathers,
         }
         return dev, layout, totals
 
@@ -1327,8 +1453,14 @@ class DeviceFleetBackend:
             labelnames=("key",),
         )
         for key in ("ops_applied", "flushes", "buffered_rows", "channels",
-                    "sharded_docs"):
+                    "sharded_docs", "reads_served", "read_gathers"):
             totals.set(tel[key], key=key)
+        # The read tier's amortization headline (telemetry/README.md
+        # read-tier vocabulary): snapshot reads served per device gather.
+        reg.gauge(
+            "reads_per_device_dispatch",
+            "snapshot reads served per batched device gather dispatch",
+        ).set(round(self.reads_per_device_dispatch, 3))
         return tel
 
     def stats(self) -> dict:
@@ -1351,5 +1483,11 @@ class DeviceFleetBackend:
             pump_backpressure=self.pump_backpressure,
             feed_size_triggers=self.feed_triggers["size"],
             feed_deadline_triggers=self.feed_triggers["deadline"],
+            reads_served=self.reads_served,
+            read_gathers=self.read_gathers,
+            read_gather_fallbacks=self.read_gather_fallbacks,
+            reads_per_device_dispatch=round(
+                self.reads_per_device_dispatch, 3
+            ),
         )
         return s

@@ -239,6 +239,14 @@ _doc_slice = jax.jit(lambda tables, scalars, doc: (
     tables[:, doc], scalars[doc]
 ))
 
+# N documents' packed states in ONE device gather (r15 read-path
+# fan-out): the flat concat crosses the link as a single transfer, so N
+# pending snapshot readers cost one readback, not N ``_doc_slice`` round
+# trips (the ``telemetry_slice`` one-readback rule on the read path).
+_docs_slice = jax.jit(lambda tables, scalars, docs: jnp.concatenate([
+    tables[:, docs].reshape(-1), scalars[docs].reshape(-1)
+]))
+
 
 class TpuFleetService:
     """Serve ``n_docs`` documents from device-resident merge state with
@@ -443,6 +451,34 @@ class TpuFleetService:
             cur_seq=scal[SC_CUR_SEQ],
             self_client=scal[SC_SELF],
             err=scal[SC_ERR],
+        )
+
+    def doc_states(self, docs) -> Dict[int, SegmentState]:
+        """N documents' merge states in EXACTLY ONE batched device→host
+        readback (the multi-doc generalization of :meth:`doc_state` —
+        r15 read-path fan-out): one device gather, one flat transfer,
+        bit-identical per-doc states (the packed unpack is shared with
+        ``DocShard.doc_states`` so the layouts cannot diverge)."""
+        from fluidframework_tpu.parallel.mesh import (
+            unpack_packed_doc_states,
+        )
+
+        from fluidframework_tpu.utils import pow2_at_least
+
+        docs = [int(d) for d in docs]
+        if not docs:
+            return {}
+        # Pow2-pad the index (padding re-gathers doc 0, discarded at
+        # unpack) so compiled gather shapes stay logarithmic in reader
+        # count — the DocFleet.doc_states_start rule.
+        pad = pow2_at_least(len(docs))
+        idx = np.zeros(pad, np.int32)
+        idx[: len(docs)] = docs
+        host = np.asarray(  # graftlint: readback(the ONE batched multi-doc gather readback — N snapshot reads, one transfer)
+            _docs_slice(self.tables, self.scalars, jnp.asarray(idx))
+        )
+        return unpack_packed_doc_states(
+            host, docs, int(self.tables.shape[-1]), pad=pad
         )
 
     def text(self, doc: int, payloads: dict) -> str:

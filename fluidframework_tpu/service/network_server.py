@@ -82,7 +82,61 @@ class _Session:
         self.doc_id: Optional[str] = None
         self.push_doc: Optional[str] = None
         self.push_seq = 0  # delivery watermark for push subscribers
+        # The r15 encode-once fan-out keeps per-subscriber state to a
+        # watermark + this requeue tail: already-encoded (seq_hi, bytes)
+        # payloads a failed write left undelivered — the next sweep
+        # drains them without re-reading the log or dragging the fan-out
+        # group's minimum watermark back.
+        self.push_tail: list = []
         self.frames_ok = False  # client negotiated the binary frame wire
+
+
+class _PushEncodeCache:
+    """Per-(doc, sweep) lazy byte cache — the encode-once contract of
+    the r15 push fan-out: each durable-log entry's wire bytes are built
+    AT MOST ONCE per sweep per wire format (one binary ws frame per
+    SeqFrame; one JSON text frame per expanded op), no matter how many
+    subscribers drain it. ``encodes`` counts actual encode passes (the
+    shim tests pin it flat across 1/10/100 subscribers)."""
+
+    __slots__ = ("_json", "_frame", "encodes")
+
+    def __init__(self) -> None:
+        self._json: Dict[int, list] = {}  # entry idx -> [(seq, bytes)]
+        self._frame: Dict[int, bytes] = {}
+        self.encodes = 0
+
+    def json_items(self, i: int, entry) -> list:
+        got = self._json.get(i)
+        if got is None:
+            self.encodes += 1
+            obj = entry[2]
+            msgs = (
+                [obj] if hasattr(obj, "sequence_number")
+                else obj.messages()
+            )
+            got = self._json[i] = [
+                (
+                    m.sequence_number,
+                    wsproto.encode_frame(
+                        wsproto.OP_TEXT,
+                        json.dumps(
+                            {"type": "op", "msg": to_jsonable(m)}
+                        ).encode(),
+                    ),
+                )
+                for m in msgs
+            ]
+        return got
+
+    def frame_bytes(self, i: int, entry) -> bytes:
+        got = self._frame.get(i)
+        if got is None:
+            self.encodes += 1
+            got = self._frame[i] = wsproto.encode_frame(
+                wsproto.OP_BINARY, entry[2].encode()
+            )
+        return got
 
 
 class FluidNetworkServer:
@@ -131,6 +185,14 @@ class FluidNetworkServer:
         # scaler's.
         self.connections_refused = 0
         self.reads_shed = 0
+        # Batched snapshot reads (r15): REST channel reads queue here
+        # for one aggregation window, then the whole batch is served by
+        # ONE device gather + ONE off-loop host transfer
+        # (DeviceFleetBackend.read_start/read_transfer/read_finish).
+        # read_batches counts served batches (tests/bench read it).
+        self._pending_reads: list = []
+        self._reads_scheduled = False
+        self.read_batches = 0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -339,22 +401,57 @@ class FluidNetworkServer:
         if not self._authorized(query, doc_id=scope):
             reply(403, b'{"error": "invalid token"}')
             return
+        # The historian-backed read tier (r15): where the service offers
+        # one, catch-up deltas, blob reads, and the latest-summary
+        # snapshot are served from its caches — cold catch-up never
+        # pumps the sequencing loop, and every hit/miss lands on
+        # read_cache_{hits,misses}_total{tier}.
+        rt = getattr(self.service, "read_tier", None)
         if method == "POST" and parts == ["blobs"]:
-            handle = self.service.store.put_blob(body)
+            handle = (
+                rt.put_blob(body) if rt is not None
+                else self.service.store.put_blob(body)
+            )
             reply(201, json.dumps({"handle": handle}).encode())
         elif method in ("GET", "HEAD") and len(parts) == 2 and parts[0] == "blobs":
-            if self.service.store.has(parts[1]):
-                data = b"" if method == "HEAD" else self.service.store.get_blob(parts[1])
+            blobs = rt if rt is not None else self.service.store
+            if blobs.has(parts[1]):
+                data = b"" if method == "HEAD" else blobs.get_blob(parts[1])
                 reply(200, data, ctype="application/octet-stream")
             else:
                 reply(404)
         elif method == "GET" and len(parts) == 2 and parts[0] == "deltas":
-            msgs = self.service.get_deltas(
-                parts[1],
-                from_seq=int(query.get("from", 0)),
-                to_seq=int(query["to"]) if "to" in query else None,
+            if rt is not None:
+                reply(200, rt.deltas_payload(
+                    parts[1],
+                    from_seq=int(query.get("from", 0)),
+                    to_seq=int(query["to"]) if "to" in query else None,
+                ))
+            else:
+                msgs = self.service.get_deltas(
+                    parts[1],
+                    from_seq=int(query.get("from", 0)),
+                    to_seq=int(query["to"]) if "to" in query else None,
+                )
+                reply(
+                    200,
+                    json.dumps([to_jsonable(m) for m in msgs]).encode(),
+                )
+        elif (
+            method == "GET"
+            and len(parts) == 3
+            and parts[0] == "documents"
+            and parts[2] == "summary"
+        ):
+            # Latest-summary snapshot read (r15): the LatestSummaryCache
+            # path — pointer probe + cached inflation, no pump.
+            summary = (
+                rt.latest_summary(parts[1]) if rt is not None else None
             )
-            reply(200, json.dumps([to_jsonable(m) for m in msgs]).encode())
+            if summary is None:
+                reply(404, b'{"error": "no summary"}')
+            else:
+                reply(200, json.dumps(summary).encode())
         elif method == "POST" and parts == ["documents"]:
             # Create (alfred POST /documents, routerlicious-base
             # alfred/routes/api): allocates the document's service state;
@@ -380,21 +477,19 @@ class FluidNetworkServer:
         ):
             # Device-served read (GET /documents/:id/channels/:cid?view=…):
             # the string channel's state straight from the service's
-            # device-resident replica — no client replica involved.
+            # device-resident replica — no client replica involved. The
+            # request queues for one aggregation window and the whole
+            # pending batch is served by ONE device gather + ONE
+            # off-loop host transfer (r15 batched snapshot reads — the
+            # reads_per_device_dispatch amortization).
             if getattr(self.service, "device", None) is None:
                 reply(501, b'{"error": "device backend unsupported"}')
                 await writer.drain()
                 return
-            doc_id, channel_id = parts[1], parts[3]
-            self.service.pump()  # settle so fresh channels are visible
-            if not self.service.device.has_channel(doc_id, channel_id):
-                reply(404, b'{"error": "unknown channel"}')
-            elif query.get("view") == "summary":
-                summary = self.service.device_summary(doc_id, channel_id)
-                reply(200, json.dumps(summary).encode())
-            else:
-                text = self.service.device_text(doc_id, channel_id)
-                reply(200, json.dumps({"text": text}).encode())
+            status, payload = await self._channel_read(
+                parts[1], parts[3], query.get("view")
+            )
+            reply(status, payload)
         elif method == "GET" and len(parts) == 2 and parts[0] == "documents":
             # Metadata (alfred GET /documents/:id): existence, head seq,
             # latest acked summary pointer, connected clients.
@@ -448,6 +543,101 @@ class FluidNetworkServer:
                 scrape=backend._telemetry_finish(host, layout, totals)
             )
         return metrics.REGISTRY.render().encode()
+
+    async def _channel_read(
+        self, doc_id: str, channel_id: str, view: Optional[str]
+    ) -> Tuple[int, bytes]:
+        """Queue one REST channel read into the pending batch and await
+        its result. The first request of a batch schedules the serving
+        task; everything that arrives within its aggregation window
+        rides the same device gather."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pending_reads.append((doc_id, channel_id, view, fut))
+        if not self._reads_scheduled:
+            self._reads_scheduled = True
+            asyncio.ensure_future(self._serve_reads())
+        return await fut
+
+    async def _serve_reads(self) -> None:
+        """Serve every queued channel read with ONE batched device
+        gather (r15): after one feed-deadline aggregation window, the
+        batch's Python-state halves (pump, flush, key resolution, state
+        split) run ON the loop — serialized with the serving traffic
+        that mutates fleet state — while the single blocking device→host
+        transfer runs off-loop (the /metrics scrape split). N pending
+        readers, one readback — ``reads_per_device_dispatch`` counts the
+        amortization."""
+        dev = getattr(self.service, "device", None)
+        window = (
+            max(float(getattr(dev, "feed_deadline_ms", 3.0)), 0.5)
+            if dev is not None else 3.0
+        ) / 1e3
+        await asyncio.sleep(window)
+        self._reads_scheduled = False
+        pending, self._pending_reads = self._pending_reads, []
+        if not pending:
+            return
+        try:
+            svc_pump = getattr(self.service, "pump", None)
+            if svc_pump is not None:
+                svc_pump()  # settle so fresh channels are visible
+            # Re-fetch: crash_device() replaces the backend.
+            dev = getattr(self.service, "device", None)
+            if dev.needs_flush():
+                dev.flush()
+            reqs = []
+            for doc_id, channel_id, view, fut in pending:
+                if not dev.has_channel(doc_id, channel_id):
+                    if not fut.done():
+                        fut.set_result(
+                            (404, b'{"error": "unknown channel"}')
+                        )
+                else:
+                    reqs.append((doc_id, channel_id, view, fut))
+            if not reqs:
+                return
+            keys = list(dict.fromkeys((d, c) for d, c, _v, _f in reqs))
+            token = dev.read_start(keys)
+            host = None
+            if token["dev"] is not None:
+                host = await asyncio.get_running_loop().run_in_executor(
+                    None, dev.read_transfer, token["dev"]
+                )
+            states = dev.read_finish(token, host)
+            # Duplicate-key requests (N readers of one hot doc) were
+            # deduped out of the gather but ARE reads served by this
+            # dispatch — the amortization counter must see them.
+            dev.reads_served += len(reqs) - len(keys)
+            self.read_batches += 1
+            for doc_id, channel_id, view, fut in reqs:
+                key = (doc_id, channel_id)
+                try:
+                    # Per-request isolation: one bad channel must fail
+                    # ITS reader, not every future in the batch.
+                    if view == "summary":
+                        payload = json.dumps(
+                            dev.summary_from_state(key, states[key])
+                        ).encode()
+                    else:
+                        payload = json.dumps({
+                            "text": dev.text_from_state(key, states[key])
+                        }).encode()
+                    result = (200, payload)
+                except Exception as e:
+                    result = (
+                        500,
+                        json.dumps({"error": repr(e)[:200]}).encode(),
+                    )
+                if not fut.done():
+                    fut.set_result(result)
+        except Exception as e:
+            for _d, _c, _v, fut in pending:
+                if not fut.done():
+                    fut.set_result((
+                        500,
+                        json.dumps({"error": repr(e)[:200]}).encode(),
+                    ))
 
     async def _pump_ticker(self) -> None:
         """The r12 deadline ticker (the continuous-feed analog of the
@@ -638,6 +828,159 @@ class FluidNetworkServer:
             return msgs[j + 1:]
         return msgs[j:]
 
+    # -- the encode-once push fan-out (r15) ----------------------------------
+
+    @inject_fault("push.fanout")
+    def _push_write(self, session: _Session, data: bytes) -> None:
+        """One fan-out delivery write of shared pre-encoded bytes — the
+        ``push.fanout`` injection boundary. Recovery: the failed
+        subscriber's remaining ALREADY-ENCODED payloads requeue as its
+        tail (``_push_send``); every other subscriber in the group keeps
+        draining the same bytes."""
+        session.writer.write(data)
+
+    #: Catch-up window per (subscriber-group, sweep): a cold subscriber
+    #: (e.g. subscribe_push from_seq=0 against a deep log) streams the
+    #: backlog in bounded per-sweep slices instead of materializing the
+    #: whole log on the event loop — and instead of dragging the shared
+    #: group read back for every caught-up subscriber.
+    PUSH_CATCHUP_SPAN = 4096
+
+    def _push_fanout(self, doc_id: str, subs: List["_Session"]) -> None:
+        """Deliver newly durable ops to every push subscriber of one doc:
+        requeued tails drain first (bytes already encoded — no re-read,
+        and a stalled subscriber never drags the group's minimum
+        watermark back), then ONE log read from the near group's minimum
+        watermark feeds the shared encode cache. Subscribers more than
+        ``PUSH_CATCHUP_SPAN`` behind the head are catch-up laggards:
+        they read their own bounded slice (grouped by watermark, so a
+        mass cold-subscribe still costs one read per distinct start
+        point) and converge on the shared read over later sweeps."""
+        live = []
+        for s in subs:
+            if s.push_tail:
+                self._push_deliver_tail(s)
+            if not s.push_tail:
+                live.append(s)
+        if not live:
+            return
+        head_fn = getattr(self.service, "doc_head", None)
+        head = head_fn(doc_id) if head_fn is not None else None
+        span = self.PUSH_CATCHUP_SPAN
+        if head is None:
+            near, laggards = live, []
+        else:
+            near = [s for s in live if head - s.push_seq <= span]
+            laggards = [s for s in live if head - s.push_seq > span]
+        if near:
+            min_wm = min(s.push_seq for s in near)
+            if head is None or head > min_wm:
+                entries = self._push_read(doc_id, min_wm, head)
+                if entries:
+                    cache = _PushEncodeCache()
+                    for s in near:
+                        self._push_deliver(s, entries, cache)
+        if laggards:
+            by_wm: Dict[int, List[_Session]] = {}
+            for s in laggards:
+                by_wm.setdefault(s.push_seq, []).append(s)
+            for wm, group in sorted(by_wm.items()):
+                entries = self._push_read(doc_id, wm, min(wm + span, head))
+                if not entries:
+                    continue
+                cache = _PushEncodeCache()
+                for s in group:
+                    self._push_deliver(s, entries, cache)
+
+    def _push_read(
+        self, doc_id: str, min_wm: int, head: Optional[int]
+    ) -> list:
+        """ONE durable-log read per (doc, sweep) from the fan-out
+        group's minimum watermark: whole sequenced frames where the
+        service stores them (``log_entries`` — the SeqFrame wire encodes
+        once per frame), per-op messages otherwise. A service with no
+        head probe scans its per-doc log once per sweep for the WHOLE
+        group — the pre-r15 per-session every-8th-tick scan gate is
+        gone; the group read is the amortization."""
+        ents = getattr(self.service, "log_entries", None)
+        if ents is not None and head is not None:
+            return ents(doc_id, min_wm + 1, head)
+        ranged = getattr(self.service, "ops_range", None)
+        if ranged is not None and head is not None:
+            msgs = ranged(doc_id, min_wm + 1, head)
+        else:
+            msgs = self.service.get_deltas(doc_id, from_seq=min_wm)
+        return [
+            (m.sequence_number, m.sequence_number, m) for m in msgs
+        ]
+
+    def _push_deliver(
+        self, s: "_Session", entries: list, cache: "_PushEncodeCache"
+    ) -> None:
+        """One subscriber's drain over the shared entry list: entries at
+        or below the watermark skip; a whole frame past the watermark
+        ships as the cached binary wire (where negotiated); a frame the
+        watermark straddles — only a mid-frame subscribe point, since
+        frames write atomically — degrades to the cached per-op JSON
+        expansion for its unseen suffix. Entries are seq-sorted and
+        non-overlapping, so a caught-up subscriber bisects straight to
+        its first unseen entry instead of re-scanning the backlog."""
+        import bisect
+
+        payloads: list = []
+        start = bisect.bisect_right(entries, s.push_seq, key=lambda e: e[1])
+        for i in range(start, len(entries)):
+            entry = entries[i]
+            lo, hi, obj = entry
+            if hi <= s.push_seq:
+                continue
+            is_frame = not hasattr(obj, "sequence_number")
+            if is_frame and s.frames_ok and lo > s.push_seq:
+                payloads.append((hi, cache.frame_bytes(i, entry), True))
+            else:
+                payloads.extend(
+                    (seq, data, False)
+                    for seq, data in cache.json_items(i, entry)
+                    if seq > s.push_seq
+                )
+        self._push_send(s, payloads)
+
+    def _push_deliver_tail(self, s: "_Session") -> None:
+        """Drain a requeued tail: the bytes were encoded on the sweep
+        that failed — delivery resumes exactly where it stopped."""
+        payloads, s.push_tail = s.push_tail, []
+        self._push_send(s, payloads)
+
+    def _push_send(self, s: "_Session", payloads: list) -> None:
+        """Write one subscriber's pending payloads in seq order. The
+        watermark advances per successful write (or past a crash-AFTER
+        write — it reached the socket; redelivering would double-send:
+        the r11 ws exactly-once rule); everything unsent requeues as the
+        subscriber's tail for the next sweep."""
+        for j, (seq, data, binary) in enumerate(payloads):
+            try:
+                self._push_write(s, data)
+            except Exception as e:
+                completed = (
+                    isinstance(e, faults.InjectedCrash) and e.completed
+                )
+                if completed:
+                    s.push_seq = max(s.push_seq, seq)
+                tail = payloads[j + 1:] if completed else payloads[j:]
+                if tail:
+                    s.push_tail = tail
+                    retry.retry_counter().inc(
+                        site="push.fanout", outcome="requeue"
+                    )
+                else:
+                    retry.retry_counter().inc(
+                        site="push.fanout", outcome="fatal"
+                    )
+                return
+            s.push_seq = max(s.push_seq, seq)
+            if binary:
+                self.frames_delivered += 1
+
     def _on_frame(self, session: _Session, payload: bytes) -> None:
         from fluidframework_tpu.protocol.opframe import OpFrame
 
@@ -751,6 +1094,10 @@ class FluidNetworkServer:
                 return
             session.push_doc = doc_id
             session.push_seq = int(msg.get("from_seq", 0))
+            # frames=True: sequenced SeqFrames deliver as ONE binary ws
+            # frame (the same bytes every frame-negotiated subscriber of
+            # the doc gets — the encode-once fan-out wire).
+            session.frames_ok = bool(msg.get("frames", False))
             self._send(session, {"type": "subscribe_push_success"})
         elif t == "submitOp" and session.conn is not None:
             session.conn.submit(from_jsonable(msg["op"]))
@@ -797,55 +1144,20 @@ class FluidNetworkServer:
                 nack = getattr(self.service, "_nack_device_errors", None)
                 if nack is not None:
                     nack()
+        # Push delivery (r15, encode-once fan-out): subscribers group by
+        # doc, the durable log is read ONCE per (doc, sweep) from the
+        # group's minimum watermark, every sequenced entry encodes ONCE
+        # per wire format, and the same bytes write to every subscriber
+        # past their watermark. Per-subscriber state is a watermark + a
+        # requeue tail — the r11 exactly-once crash-after semantics per
+        # socket are unchanged.
+        push_groups: Dict[str, List[_Session]] = {}
         for s in self._sessions:
             if s.push_doc is not None:
-                # Push delivery: stream newly sequenced ops straight from
-                # the durable log past the subscriber's watermark. A cheap
-                # head probe skips idle ticks; ranged lookup (where the
-                # service offers it) keeps per-tick cost O(new ops), not
-                # O(log).
-                head_fn = getattr(self.service, "doc_head", None)
-                head = head_fn(s.push_doc) if head_fn else None
-                if head is not None and head <= s.push_seq:
-                    continue
-                ranged = getattr(self.service, "ops_range", None)
-                if ranged is not None and head is not None:
-                    msgs = ranged(s.push_doc, s.push_seq + 1, head)
-                else:
-                    # No head probe on this service: the fallback scans
-                    # (sorts/filters) the whole per-doc log, so gate it
-                    # to every 8th tick — bounded extra latency instead
-                    # of O(log) work on every idle drain.
-                    s.push_scan_tick = getattr(s, "push_scan_tick", 0) + 1
-                    if head is None and s.push_scan_tick % 8 != 1:
-                        continue
-                    msgs = self.service.get_deltas(
-                        s.push_doc, from_seq=s.push_seq
-                    )
-                for m in msgs:
-                    try:
-                        self._deliver_obj(
-                            s, {"type": "op", "msg": to_jsonable(m)}
-                        )
-                    except Exception as e:
-                        # Push watermark: advance past a crash-after write
-                        # (it reached the socket), never past a lost one —
-                        # the next tick re-reads the durable log from the
-                        # watermark, so nothing is lost or re-sent. Only
-                        # a write that actually needs re-reading counts
-                        # as a requeue.
-                        if isinstance(e, faults.InjectedCrash) and e.completed:
-                            s.push_seq = max(s.push_seq, m.sequence_number)
-                            retry.retry_counter().inc(
-                                site="ws.deliver", outcome="fatal"
-                            )
-                        else:
-                            retry.retry_counter().inc(
-                                site="ws.deliver", outcome="requeue"
-                            )
-                        break
-                    s.push_seq = max(s.push_seq, m.sequence_number)
-                continue
+                push_groups.setdefault(s.push_doc, []).append(s)
+        for doc_id, subs in push_groups.items():
+            self._push_fanout(doc_id, subs)
+        for s in self._sessions:
             if s.conn is None:
                 continue
             nopump = getattr(s.conn, "supports_nopump", False)

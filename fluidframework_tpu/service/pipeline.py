@@ -172,6 +172,14 @@ class PipelineFluidService:
         self.log = log if log is not None else PartitionedLog(n_partitions)
         self.store = store if store is not None else SummaryStore()
         self.checkpoints = CheckpointStore()
+        # The historian-backed read tier (r15): REST catch-up and
+        # snapshot reads route through this caching façade — immutable
+        # delta chunks, the LatestSummaryCache'd summary pointer, and
+        # blob reads through a CachingBlobBackend over the store — so
+        # cold catch-up never pumps the sequencing loop.
+        from fluidframework_tpu.service.historian import HistorianReadTier
+
+        self.read_tier = HistorianReadTier(self)
         # Sampled op tracing at the front door (alfred stamps 1-in-N,
         # reference config.json:58 numberOfMessagesPerTrace; 0 = off).
         self.trace_sampler = (
@@ -773,17 +781,70 @@ class PipelineFluidService:
         return ops.head if ops is not None else 0
 
     def ops_range(
-        self, doc_id: str, from_seq: int, to_seq: int
+        self, doc_id: str, from_seq: int, to_seq: int,
+        pump: bool = True,
     ) -> List[SequencedDocumentMessage]:
         """Ops in [from_seq, to_seq] by direct seq lookup — O(k) for push
-        delivery, vs get_deltas's full-log sort."""
-        self.pump()
+        delivery, vs get_deltas's full-log sort. ``pump=False`` is the
+        read tier's no-pump form (r15): catch-up reads served from the
+        durable log must never drive the sequencing loop."""
+        if pump:
+            self.pump()
         ops = self.ops_store.get(doc_id, {})
         return [
             stored_message(ops[s])
             for s in range(from_seq, to_seq + 1)
             if s in ops
         ]
+
+    def log_entries(
+        self, doc_id: str, from_seq: int, to_seq: int
+    ) -> List[tuple]:
+        """Durable-log entries overlapping [from_seq, to_seq] in seq
+        order, WITHOUT expanding frames: each entry is ``(lo, hi, obj)``
+        where ``obj`` is a whole :class:`SeqFrame` (hi = its last seq) or
+        a single :class:`SequencedDocumentMessage` (lo == hi). The
+        encode-once push fan-out consumes this — one read per (doc,
+        sweep) from the group's minimum watermark, frames delivered as
+        ONE binary wire frame to every subscriber that negotiated them.
+        No pump: push delivery streams what is already durable."""
+        log = self.ops_store.get(doc_id)
+        if log is None:
+            return []
+        # Point ops: probe the requested window, not the whole dict —
+        # the steady-state window is O(new ops) and a full-dict scan
+        # per push sweep would be quadratic over the doc's lifetime.
+        # A window far wider than the stored point ops (cold catch-up
+        # over a frame-dominated log) flips to the dict scan instead.
+        if to_seq - from_seq + 1 <= 4 * len(log.ops):
+            entries: List[tuple] = [
+                (s, s, log.ops[s])
+                for s in range(from_seq, to_seq + 1)
+                if s in log.ops
+            ]
+        else:
+            entries = [
+                (s, s, m)
+                for s, m in log.ops.items()
+                if from_seq <= s <= to_seq
+            ]
+        import bisect
+
+        i = max(0, bisect.bisect_right(log._starts, from_seq) - 1)
+        for f in log.frames[i:]:
+            if f.first_seq > to_seq:
+                break
+            if f.last_seq >= from_seq:
+                entries.append((f.first_seq, f.last_seq, f))
+        entries.sort(key=lambda e: e[0])
+        return entries
+
+    def latest_summary_pointer(self, doc_id: str) -> Optional[tuple]:
+        """(handle, head) of the doc's latest scribe-acked summary, or
+        None — the read tier's no-pump pointer probe (cheap host state;
+        the historian façade invalidates its inflated copy on change)."""
+        sd = self._scribe_doc(doc_id)
+        return sd.latest_summary if sd is not None else None
 
     def get_deltas(
         self, doc_id: str, from_seq: int = 0, to_seq: Optional[int] = None

@@ -100,6 +100,22 @@ SITES: Dict[str, str] = {
     # slam it shut); the next observation re-evaluates from live
     # pressure.
     "shed.tier": "fallback",
+    # Batched snapshot gather (DeviceFleetBackend._gather_start — the
+    # r15 read tier's one-readback multi-doc device gather): a failed or
+    # crashed gather falls back to per-doc host gathers (counted
+    # retry_attempts_total{read.gather,fallback}) — reads are idempotent
+    # and side-effect-free on device state, so re-reading after any
+    # boundary crash serves the same bytes; the reader never sees the
+    # fault, only the amortization counter does.
+    "read.gather": "fallback",
+    # Encode-once push fan-out write (FluidNetworkServer._push_write —
+    # one subscriber's delivery of shared pre-encoded bytes): a failed
+    # write requeues ONLY that subscriber's already-encoded tail at its
+    # tail head (watermarks advance only with a successful write; a
+    # crash AFTER the write advances past the delivered entry — the
+    # ws.deliver exactly-once rule per socket), and every other
+    # subscriber in the fan-out group keeps draining.
+    "push.fanout": "requeue",
     # Flight-recorder auto-dump (telemetry/journal.py _write_dump — the
     # r14 post-mortem file write): the journal is best-effort by
     # contract — a failed or crashed dump is counted

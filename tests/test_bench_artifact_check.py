@@ -327,6 +327,48 @@ def test_r13_exempt_from_journal_keys(tmp_path):
     assert cba.check(str(tmp_path)) == 0
 
 
+_R14_COMPLETE = dict(
+    _R13_COMPLETE,
+    journal_overhead_frac=0.012,
+    serving_stage_p99_ms={"deli": 0.4, "total": 9.1},
+)
+
+
+def test_r15_requires_read_fanout_keys(tmp_path):
+    """An r15+ artifact must carry the read-tier trio — encode-once
+    fan-out throughput, the per-subscriber delivery p99, AND the
+    batched-gather amortization number."""
+    cba = _tool()
+    _write(tmp_path, "BENCH_r15.json", [json.dumps(_R14_COMPLETE)])
+    assert cba.check(str(tmp_path)) == 1
+    # A subset of the trio is not enough.
+    _write(tmp_path, "BENCH_r15.json", [json.dumps(dict(
+        _R14_COMPLETE, serving_read_fanout_ops_per_sec=123456,
+    ))])
+    assert cba.check(str(tmp_path)) == 1
+    _write(tmp_path, "BENCH_r15.json", [json.dumps(dict(
+        _R14_COMPLETE,
+        serving_read_fanout_ops_per_sec=123456,
+        serving_read_delivery_p99_ms=2.5,
+    ))])
+    assert cba.check(str(tmp_path)) == 1
+    _write(tmp_path, "BENCH_r15.json", [json.dumps(dict(
+        _R14_COMPLETE,
+        serving_read_fanout_ops_per_sec=123456,
+        serving_read_delivery_p99_ms=2.5,
+        reads_per_device_dispatch=64.0,
+    ))])
+    assert cba.check(str(tmp_path)) == 0
+
+
+def test_r14_exempt_from_read_fanout_keys(tmp_path):
+    """Per-key since-round gating: an r14 artifact predates the
+    read-tier trio and passes with the fifteen prior keys."""
+    cba = _tool()
+    _write(tmp_path, "BENCH_r14.json", [json.dumps(_R14_COMPLETE)])
+    assert cba.check(str(tmp_path)) == 0
+
+
 def test_newest_round_governs(tmp_path):
     cba = _tool()
     _write(tmp_path, "BENCH_r05.json", ['{"metric": "old"}'])

@@ -651,6 +651,50 @@ def test_fault_site_flags_unregistered_overload_site(tmp_path):
     assert "admission.precheck" in findings[0].message
 
 
+def test_fault_site_accepts_read_tier_sites(tmp_path):
+    """The r15 read-tier sites — the batched snapshot gather and the
+    encode-once fan-out write — are documented vocabulary: production
+    boundaries decorated with them pass lint."""
+    findings = _run_pass(
+        _fault_site_pass(),
+        """
+        from fluidframework_tpu.testing.faults import inject_fault
+
+        @inject_fault("read.gather")
+        def gather(backend, idxs):
+            return backend.fleet.doc_states_start(idxs)
+
+        @inject_fault("push.fanout")
+        def push_write(server, session, data):
+            session.writer.write(data)
+        """,
+        tmp_path,
+    )
+    assert findings == []
+
+
+def test_fault_site_flags_unregistered_read_site(tmp_path):
+    """The r15 regression shape: a read-path boundary added to a
+    production module without declaring it in the vocabulary (e.g. a
+    second gather named off-vocabulary) must fail lint — the fallback
+    contract (per-doc host gathers, counted) only exists if the site is
+    documented."""
+    findings = _run_pass(
+        _fault_site_pass(),
+        """
+        from fluidframework_tpu.testing.faults import inject_fault
+
+        @inject_fault("read.batch")
+        def batch(backend, keys):
+            return backend.doc_states(keys)
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "unknown injection site" in findings[0].message
+    assert "read.batch" in findings[0].message
+
+
 def test_fault_site_flags_unregistered_recovery(tmp_path):
     """A vocabulary entry whose recovery kind is not documented is a
     production site nobody catches — a lint failure, not a latent

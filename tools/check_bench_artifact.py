@@ -40,7 +40,11 @@ from typing import List, Tuple
 # the counted load-shedding tier transitions — in r13; the
 # flight-recorder pair — the measured journal-on/journal-off serving
 # overhead (asserted ≤ 0.05 in-bench) and the per-stage p99 tail next
-# to the r9 means — in r14.
+# to the r9 means — in r14; the read-tier trio — encode-once fan-out
+# throughput (asserted ≥ 5× the per-subscriber-encode baseline
+# in-bench), the per-subscriber delivery p99 across the 10k-subscriber
+# fan-out, and the batched-snapshot-gather amortization (asserted > 1
+# under concurrent load) — in r15.
 REQUIRED = (
     ("pipeline_serving_ops_per_sec", 6),
     ("deli_scribe_e2e_ops_per_sec", 6),
@@ -57,6 +61,9 @@ REQUIRED = (
     ("serving_overload_tier_transitions", 13),
     ("journal_overhead_frac", 14),
     ("serving_stage_p99_ms", 14),
+    ("serving_read_fanout_ops_per_sec", 15),
+    ("serving_read_delivery_p99_ms", 15),
+    ("reads_per_device_dispatch", 15),
 )
 # Artifacts up to round 5 predate every gated metric.
 BASELINE_ROUND = 5
