@@ -934,7 +934,7 @@ def _config7_measure(
         ("foreman", svc._foreman),
     ]
     stage_s = {name: 0.0 for name, _r in stages}
-    flush_staging_s = flush_dispatch_s = 0.0
+    flush_staging_s = flush_dispatch_s = flush_routing_s = 0.0
     submit_s = 0.0
     cseq = {d: 0 for d in doc_ids}
     orig = {d: 0 for d in doc_ids}
@@ -1010,6 +1010,7 @@ def _config7_measure(
 
     def run_round(r: int, timed: bool) -> None:
         nonlocal submit_s, flush_staging_s, flush_dispatch_s
+        nonlocal flush_routing_s
         pre = dict(svc.device.flush_totals)
         t0 = time.perf_counter()
         send(timed)
@@ -1032,6 +1033,11 @@ def _config7_measure(
             tot = svc.device.flush_totals
             flush_staging_s += tot["staging_s"] - pre["staging_s"]
             flush_dispatch_s += tot["dispatch_s"] - pre["dispatch_s"]
+            # r16: the fleet-side routing wall left staging_s for its
+            # own bucket (staging_s is now a pure derived view of the
+            # profiler intervals) — report it so the flush breakdown
+            # still sums to the flush wall across rounds.
+            flush_routing_s += tot["routing_s"] - pre["routing_s"]
         # Broadcast delivery was already paid above; drop the inboxes so a
         # long run's memory stays bounded (a real room's sockets drain).
         for c in conns.values():
@@ -1079,6 +1085,7 @@ def _config7_measure(
         pipeline_s=round(pipeline_s, 3),
         flush_staging_s=round(flush_staging_s, 4),
         flush_dispatch_s=round(flush_dispatch_s, 4),
+        flush_routing_s=round(flush_routing_s, 4),
         read_text_ms_per_doc=round(1e3 * t_text / len(sample), 3),
         read_summary_ms_per_doc=round(1e3 * t_summary / len(sample), 3),
         errs=stats["docs_with_errors"],

@@ -66,7 +66,7 @@ from fluidframework_tpu.parallel.fleet import (
 )
 from fluidframework_tpu.protocol.constants import F_ARG, F_SEQ, OP_WIDTH
 from fluidframework_tpu.service import retry
-from fluidframework_tpu.telemetry import journal, metrics, tracing
+from fluidframework_tpu.telemetry import journal, metrics, profiler, tracing
 from fluidframework_tpu.testing import faults
 from fluidframework_tpu.testing.faults import inject_fault
 from fluidframework_tpu.utils import pow2_at_least as _pow2_at_least
@@ -86,10 +86,11 @@ class _RingSlot:
 
     __slots__ = (
         "dev_rows", "host_rows", "docs", "lens", "rows", "traces", "jspans",
+        "bid",
     )
 
     def __init__(self, dev_rows, host_rows, docs, lens, rows, traces,
-                 jspans=()):
+                 jspans=(), bid=-1):
         self.dev_rows = dev_rows
         self.host_rows = host_rows
         self.docs = docs
@@ -100,6 +101,11 @@ class _RingSlot:
         # runs this boxcar carries — stamped once at stage time, reused
         # by the dispatch and commit events (journal-off: empty).
         self.jspans = jspans
+        # Serving-profiler boxcar id (r16): stamped once at stage time;
+        # the dispatch/device_step/scan_consume intervals this slot's
+        # round produces all carry it, so the timeline can attribute
+        # the per-round host tax (profiler off: -1).
+        self.bid = bid
 
 
 class IngestRing:
@@ -206,8 +212,15 @@ class DeviceFleetBackend:
         # flushes fire from inside enqueue when the boxcar fills, so a
         # last-only view misses most of them).
         self.last_flush_breakdown: Dict[str, float] = {}
+        # routing_s (r16): the fleet-side host routing that runs INSIDE
+        # the dispatch call (fleet.last_routing_s) used to be folded
+        # back into staging_s; it now has its own bucket so staging_s
+        # is a PURE derived view of the profiler's host_stage/ring_put
+        # interval clock reads (the one-clock satellite, equivalence
+        # regression-tested).
         self.flush_totals: Dict[str, float] = {
-            "staging_s": 0.0, "dispatch_s": 0.0, "staged_rows": 0,
+            "staging_s": 0.0, "dispatch_s": 0.0, "routing_s": 0.0,
+            "staged_rows": 0,
         }
         # The continuous device pump (r10): double-buffered ingest ring +
         # AOT donated dispatch. pump_mode routes flush() through the
@@ -223,6 +236,15 @@ class DeviceFleetBackend:
         self.pump_busy_s = 0.0
         self._busy_edge = 0.0
         self._scan_dispatch_t: Optional[float] = None
+        # Serving-profiler round tracking (r16): every staged boxcar
+        # gets a monotone id; _scan_bid remembers which boxcar the
+        # in-flight health scan covers so the device_step/scan_consume
+        # intervals close against the right round. pump_busy_s and
+        # flush_totals are DERIVED from the same perf_counter reads the
+        # profiler intervals use — one clock, one record site
+        # (equivalence regression-tested).
+        self._boxcar_seq = 0
+        self._scan_bid = -1
         # The continuous front door (r12): boxcar formation is streaming
         # and time-bounded, not quiescence-gated. pump_feed() stages a
         # boxcar as soon as the buffers reach max_batch (size trigger) OR
@@ -439,17 +461,18 @@ class DeviceFleetBackend:
 
     def _stage_host(
         self,
-    ) -> Tuple[np.ndarray, List[np.ndarray], np.ndarray, tuple]:
+    ) -> Tuple[np.ndarray, List[np.ndarray], np.ndarray, tuple, int]:
         """One boxcar's host assembly, shared by the pump and one-shot
         paths: drain the channel buffers up to each doc's chunk limit
         (the over-limit remainder stays buffered for the next boxcar) and
         run the watermark bookkeeping as two fancy-indexed array ops —
         the per-channel dict loop this replaces was residual Python wall
         inside the pump at 10k+ busy channels (r10 satellite). Returns
-        ``(idxs, rows_list, lens, jspans)`` — ``jspans`` is the flight-
-        recorder coverage tuple (per-channel ``(doc, lo, hi)`` seq runs;
-        empty with the journal disabled, so the hot path pays one
-        predicate)."""
+        ``(idxs, rows_list, lens, jspans, bid)`` — ``jspans`` is the
+        flight-recorder coverage tuple (per-channel ``(doc, lo, hi)``
+        seq runs; empty with the journal disabled, so the hot path pays
+        one predicate) and ``bid`` is the boxcar's monotone serving-
+        profiler round id."""
         buffers = self._buffers
         n = len(buffers)
         idxs = np.fromiter(buffers.keys(), np.int64, n)
@@ -512,12 +535,13 @@ class DeviceFleetBackend:
             journal.record(
                 "device.stage", spans=jspans, rows=int(lens.sum())
             )
-        return idxs, rows_list, lens, jspans
+        self._boxcar_seq += 1
+        return idxs, rows_list, lens, jspans, self._boxcar_seq
 
     def _flush_oneshot(self) -> List[ChannelKey]:
         """The pre-pump serving loop (the pump's parity reference)."""
         newly_errored: List[ChannelKey] = []
-        staging_s = dispatch_s = 0.0
+        staging_s = dispatch_s = routing_s = 0.0
         staged_rows = 0
         while self._buffers:
             # Consume the PREVIOUS dispatch's health scan before routing
@@ -531,7 +555,7 @@ class DeviceFleetBackend:
             # channel shipped the same row count (the round-shaped frame
             # wire's common case).
             t0 = time.perf_counter()
-            idxs, rows_list, lens, jspans = self._stage_host()
+            idxs, rows_list, lens, jspans, bid = self._stage_host()
             n = len(idxs)
             if self._sharded:
                 shard_sel = np.fromiter(
@@ -565,15 +589,32 @@ class DeviceFleetBackend:
                 t1 = time.perf_counter()
                 self.fleet.apply_sparse(fleet_docs, ops_b)
                 t2 = time.perf_counter()
-                staging_s += (t1 - t0) + self.fleet.last_routing_s
+                if profiler._ON:
+                    # One clock: the SAME t0/t1/t2 reads feed the
+                    # profiler lanes and the legacy staging/dispatch
+                    # split below (derived view, not a second clock).
+                    profiler.record(
+                        "host_stage", t0, t1, boxcar=bid,
+                        rows=int(lens.sum()),
+                    )
+                    profiler.record("dispatch", t1, t2, boxcar=bid)
+                staging_s += t1 - t0
+                routing_s += self.fleet.last_routing_s
                 dispatch_s += (t2 - t1) - self.fleet.last_routing_s
                 staged_rows += ops_b.shape[0] * k
                 self._scan_token = self.fleet.begin_scan()
+                self._scan_bid = bid
                 if jspans:
                     journal.record("device.dispatch", spans=jspans)
                     self._journal_inflight.append(jspans)
             else:
-                staging_s += time.perf_counter() - t0
+                t1 = time.perf_counter()
+                if profiler._ON:
+                    profiler.record(
+                        "host_stage", t0, t1, boxcar=bid,
+                        rows=int(lens.sum()),
+                    )
+                staging_s += t1 - t0
             self._flushes += 1
             compact_now = self._flushes % self.compact_every == 0
             for idx, rows in sharded_rows.items():
@@ -595,10 +636,12 @@ class DeviceFleetBackend:
         self.last_flush_breakdown = {
             "staging_s": staging_s,
             "dispatch_s": dispatch_s,
+            "routing_s": routing_s,
             "staged_rows": staged_rows,
         }
         self.flush_totals["staging_s"] += staging_s
         self.flush_totals["dispatch_s"] += dispatch_s
+        self.flush_totals["routing_s"] += routing_s
         self.flush_totals["staged_rows"] += staged_rows
         self._unreported.extend(newly_errored)
         return newly_errored
@@ -704,13 +747,14 @@ class DeviceFleetBackend:
         if self._ring.full():
             self.pump_backpressure += 1
             self._dispatch_one()
+        feed_edge = self._feed_edge  # _stage_host re-arms it
         t0 = time.perf_counter()
         traces = self._trace_pending
         self._trace_pending = []
         for t in traces:
             tracing.stamp(t, tracing.STAGE_FEED_WAIT, "end")
             tracing.stamp(t, tracing.STAGE_RING_STAGE, "start")
-        idxs, rows_list, lens, jspans = self._stage_host()
+        idxs, rows_list, lens, jspans, bid = self._stage_host()
         n = len(idxs)
         k = _pow2_at_least(max(int(lens.max()), 8))
         b = _pow2_at_least(n)
@@ -721,16 +765,31 @@ class DeviceFleetBackend:
         else:
             for j, rows in enumerate(rows_list):
                 rows_b[j, : rows.shape[0]] = rows
+        t_host = time.perf_counter()
         dev_rows = jax.device_put(rows_b)  # async upload into the slot
+        t_put = time.perf_counter()
+        if profiler._ON:
+            # One clock, one record site (r16): the SAME perf_counter
+            # reads feed the timeline lanes and the legacy staging_s
+            # accumulation below — the counter is a derived view of the
+            # intervals (equivalence regression-tested).
+            rows_n = int(lens.sum())
+            if feed_edge is not None:
+                profiler.record("feed_wait", feed_edge, t0, boxcar=bid,
+                                rows=rows_n)
+            profiler.record("host_stage", t0, t_host, boxcar=bid,
+                            rows=rows_n)
+            profiler.record("ring_put", t_host, t_put, boxcar=bid,
+                            rows=rows_n)
         for t in traces:
             tracing.stamp(t, tracing.STAGE_RING_STAGE, "end")
         self._ring.push(
             _RingSlot(
                 dev_rows, rows_b, idxs, lens, int(lens.sum()), traces,
-                jspans,
+                jspans, bid,
             )
         )
-        self.flush_totals["staging_s"] += time.perf_counter() - t0
+        self.flush_totals["staging_s"] += (t_host - t0) + (t_put - t_host)
         self.flush_totals["staged_rows"] += b * k
         return True
 
@@ -789,6 +848,7 @@ class DeviceFleetBackend:
             tracing.stamp(t, tracing.STAGE_DEVICE_STEP, "start")
         in_fleet = self.fleet.doc_caps(slot.docs) > 0
         if in_fleet.any():
+            t_d0 = time.perf_counter()
             try:
                 self._dispatch_device(slot.docs, slot.dev_rows)
             except faults.InjectedCrash as e:
@@ -835,7 +895,17 @@ class DeviceFleetBackend:
                 journal.retry_outcome("pump.dispatch", "fatal")
                 raise
             self._scan_token = self.fleet.begin_scan()
-            self._scan_dispatch_t = time.perf_counter()
+            # One perf_counter read closes the dispatch interval AND
+            # arms the busy-union edge — the device_step interval this
+            # round later produces starts from the same float.
+            t_d1 = time.perf_counter()
+            self._scan_dispatch_t = t_d1
+            self._scan_bid = slot.bid
+            if profiler._ON:
+                profiler.record(
+                    "dispatch", t_d0, t_d1, boxcar=slot.bid,
+                    rows=slot.rows,
+                )
         if slot.jspans:
             journal.record("device.dispatch", spans=slot.jspans)
             self._journal_inflight.append(slot.jspans)
@@ -872,7 +942,10 @@ class DeviceFleetBackend:
         self.flush_totals["dispatch_s"] += (
             time.perf_counter() - t0 - routing
         )
-        self.flush_totals["staging_s"] += routing
+        # Fleet-side host routing inside the dispatch call: its own
+        # bucket (r16), so staging_s stays a pure derived view of the
+        # host_stage/ring_put profiler intervals.
+        self.flush_totals["routing_s"] += routing
         self._unreported.extend(newly)
         return newly
 
@@ -1075,6 +1148,7 @@ class DeviceFleetBackend:
             return
         for t in self._trace_inflight:
             tracing.stamp(t, tracing.STAGE_SCAN_CONSUME, "start")
+        t_c0 = time.perf_counter()
         host = None
         if self._scan_prefetch is not None:
             tok, pre = self._scan_prefetch
@@ -1086,13 +1160,23 @@ class DeviceFleetBackend:
         scans = self.fleet.finish_scan(self._scan_token, host=host)
         self._scan_token = None
         now = time.perf_counter()
+        scan_bid, self._scan_bid = self._scan_bid, -1
+        if profiler._ON:
+            profiler.record("scan_consume", t_c0, now, boxcar=scan_bid)
         if self._scan_dispatch_t is not None:
             # Union of dispatch->readback intervals (ordered, so a
             # running edge suffices): busy wall the device provably had
             # work queued; 1 - busy/wall is the idle fraction.
+            # pump_busy_s is a DERIVED view of the per-boxcar
+            # device_step interval (r16): both come from the same
+            # start/now floats — one clock, one record site.
             start = max(self._scan_dispatch_t, self._busy_edge)
             if now > start:
                 self.pump_busy_s += now - start
+                if profiler._ON:
+                    profiler.record(
+                        "device_step", start, now, boxcar=scan_bid
+                    )
             self._busy_edge = now
             self._scan_dispatch_t = None
         for t in self._trace_inflight:

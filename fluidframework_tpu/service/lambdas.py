@@ -24,6 +24,7 @@ seq, broadcaster drops seqs already delivered to a connection).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -42,6 +43,7 @@ from fluidframework_tpu.telemetry import (
     Lumberjack,
     journal,
     metrics,
+    profiler,
     tracing,
 )
 from fluidframework_tpu.testing.faults import inject_fault
@@ -469,9 +471,18 @@ class DeliDocLambda(PartitionLambda):
             tracing.stamp(traces, tracing.STAGE_ALFRED, "end")
             tracing.stamp(traces, tracing.STAGE_DELI, "start")
         fr = frame.rows
+        prof = profiler._ON  # the r16 timeline's ticket lane (one
+        # predicate untraced; armed, the SAME two perf_counter reads
+        # bracket the native ticketer call)
+        if prof:
+            t_tk0 = time.perf_counter()
         res = self.sequencer.ticket_frame(
             client, frame.csn0, frame.n, fr[:, F_REF]
         )
+        if prof:
+            profiler.record(
+                "ticket", t_tk0, time.perf_counter(), rows=frame.n
+            )
         if traces is not None:
             tracing.stamp(traces, tracing.STAGE_DELI, "end")
         if res is None:

@@ -174,6 +174,18 @@ class FluidNetworkServer:
         # (tests wait on it).
         self._pump_task: Optional[asyncio.Task] = None
         self.pump_ticks = 0
+        # The loop-stall watchdog (r16): a sentinel task measures the
+        # socket loop's expected-vs-actual tick delta every period and
+        # exports it as the event_loop_lag_ms gauge; past the threshold
+        # it journals a loop.stall event (a blocking readback regression
+        # on the loop is caught BY NAME) and, while a /profilez capture
+        # is armed, records a loop_lag timeline interval. lag_ticks
+        # counts sentinel wakeups (tests wait on it); stalls_seen counts
+        # threshold crossings.
+        self._lag_task: Optional[asyncio.Task] = None
+        self.loop_lag_threshold_ms = 50.0
+        self.lag_ticks = 0
+        self.stalls_seen = 0
         # The overload envelope (r13): the REFUSE_CONNECTIONS tier gates
         # the accept path (a refused socket gets a 503 + Retry-After
         # right after the bounded header read and holds ZERO session
@@ -214,6 +226,13 @@ class FluidNetworkServer:
             dev = getattr(self.service, "device", None)
             if dev is not None and getattr(dev, "pump_mode", False):
                 self._pump_task = asyncio.ensure_future(self._pump_ticker())
+            # The loop-stall watchdog runs on EVERY front door (a
+            # device-less service can still block its loop), and the gc
+            # pause hooks install once per process (idempotent).
+            self._lag_task = asyncio.ensure_future(self._lag_sentinel())
+            from fluidframework_tpu.telemetry import profiler
+
+            profiler.install_gc_hooks()
             self._started.set()
 
         self._loop.run_until_complete(boot())
@@ -227,12 +246,13 @@ class FluidNetworkServer:
             return
 
         async def shutdown():
-            if self._pump_task is not None:
-                self._pump_task.cancel()
-                try:
-                    await self._pump_task
-                except (asyncio.CancelledError, Exception):
-                    pass
+            for task in (self._pump_task, self._lag_task):
+                if task is not None:
+                    task.cancel()
+                    try:
+                        await task
+                    except (asyncio.CancelledError, Exception):
+                        pass
             for s in list(self._sessions):
                 self._close_session(s)
             if self._server is not None:
@@ -388,6 +408,61 @@ class FluidNetworkServer:
                     ),
                 },
             )
+            await writer.drain()
+            return
+        if method == "GET" and parts == ["profilez"]:
+            # The serving timeline profiler (r16): arm a bounded capture
+            # window, sleep it out on the loop (serving continues — the
+            # producers record from the traffic this very socket loop
+            # keeps driving), and return the Perfetto/Chrome trace JSON.
+            # Deliberately AFTER the SHED_READS branch above and OUTSIDE
+            # the REFUSE_CONNECTIONS exemption tuple: an armed capture
+            # ALLOCATES, so under overload /profilez is shed with
+            # Retry-After like any read — the opposite of /metrics and
+            # /debugz, whose exemption exists because they allocate
+            # nothing the envelope needs to protect.
+            import math
+
+            from fluidframework_tpu.telemetry import profiler
+
+            try:
+                duration_ms = float(query.get("duration_ms", 250.0))
+            except ValueError:
+                duration_ms = float("nan")
+            if not math.isfinite(duration_ms):
+                # NaN slips through min/max clamps (every comparison is
+                # False) and would defeat the self-disarm deadline AND
+                # hang this handler's sleep — reject it at the edge.
+                reply(400, b'{"error": "malformed duration_ms"}')
+                await writer.drain()
+                return
+            duration_ms = min(
+                max(duration_ms, 1.0), profiler.MAX_WINDOW_MS
+            )
+            if profiler.enabled():
+                # One capture at a time: a concurrent arm would reset
+                # the ring mid-capture and the first requester's disarm
+                # would truncate the second's window — both silently
+                # wrong. Serialize at the surface.
+                reply(
+                    409, b'{"error": "a capture is already armed"}',
+                    headers={"Retry-After": 1},
+                )
+                await writer.drain()
+                return
+            if not profiler.arm(duration_ms):
+                # Counted retry_attempts_total{profiler.arm,fallback}
+                # inside arm() and absorbed — the capture fails, the
+                # serving path does not.
+                reply(
+                    503, b'{"error": "profiler arm failed"}',
+                    headers={"Retry-After": 1},
+                )
+                await writer.drain()
+                return
+            await asyncio.sleep(duration_ms / 1e3)
+            profiler.disarm()
+            reply(200, json.dumps(profiler.chrome_trace()).encode())
             await writer.drain()
             return
         # Delta/document routes are doc-scoped; blob routes use a
@@ -638,6 +713,49 @@ class FluidNetworkServer:
                         500,
                         json.dumps({"error": repr(e)[:200]}).encode(),
                     ))
+
+    #: Loop-lag sentinel period (s): the expected tick delta the stall
+    #: watchdog measures against. Small enough to catch a blocked loop
+    #: within one blocking call, cheap enough to run always (one sleep +
+    #: two perf_counter reads + one gauge set per period).
+    LOOP_LAG_PERIOD_S = 0.025
+
+    async def _lag_sentinel(self) -> None:
+        """The r16 loop-stall watchdog: sleep one period, measure the
+        overshoot. A healthy loop wakes within scheduler jitter of the
+        period; a loop blocked by a synchronous device readback, a
+        compile, or a long Python pass overshoots by the blocked wall —
+        which this task measures BY CONSTRUCTION (its wakeup queues
+        behind the blocking call), exports as ``event_loop_lag_ms``,
+        journals past the threshold (``loop.stall``), and records on the
+        ``loop_lag`` timeline lane while a /profilez capture is armed."""
+        from fluidframework_tpu.telemetry import journal, profiler
+
+        period = self.LOOP_LAG_PERIOD_S
+        while True:
+            t0 = time.perf_counter()
+            await asyncio.sleep(period)
+            t1 = time.perf_counter()
+            self.lag_ticks += 1
+            lag_ms = max(0.0, (t1 - t0 - period) * 1e3)
+            # Re-resolved per tick (one dict probe): the registry idiom
+            # that survives a test-isolation REGISTRY.reset().
+            profiler.loop_lag_gauge().set(round(lag_ms, 3))
+            # Fold buffered collector pauses into their metric families
+            # every tick (the gc callback itself is lock-free by
+            # contract — it only buffers; see profiler.drain_gc_events).
+            profiler.drain_gc_events()
+            if lag_ms >= self.loop_lag_threshold_ms:
+                self.stalls_seen += 1
+                if journal._ON:
+                    journal.record(
+                        "loop.stall", lag_ms=round(lag_ms, 3),
+                        threshold_ms=self.loop_lag_threshold_ms,
+                    )
+                if profiler._ON:
+                    # The stall interval is the overshoot itself: the
+                    # expected wake instant to the actual one.
+                    profiler.record("loop_lag", t0 + period, t1)
 
     async def _pump_ticker(self) -> None:
         """The r12 deadline ticker (the continuous-feed analog of the

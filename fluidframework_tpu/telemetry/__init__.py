@@ -25,7 +25,7 @@ from fluidframework_tpu.telemetry.lumberjack import (
     LumberEventName,
     Lumberjack,
 )
-from fluidframework_tpu.telemetry import journal, metrics, tracing
+from fluidframework_tpu.telemetry import journal, metrics, profiler, tracing
 from fluidframework_tpu.telemetry.metrics import (
     Counter,
     Gauge,
@@ -53,5 +53,6 @@ __all__ = [
     "PerformanceEvent",
     "TelemetryLogger",
     "journal",
+    "profiler",
     "tracing",
 ]

@@ -99,6 +99,11 @@ EVENTS: Dict[str, str] = {
     "lease.fence": "epoch fence rejected a stale lease owner",
     "tree.fallback": "SharedTree ingest host-fallback attribution",
     "journal.dump": "the flight recorder dumped itself to a file",
+    # The loop-stall watchdog (r16, telemetry/profiler.py + the network
+    # server's lag sentinel): the asyncio serving loop overshot its
+    # expected tick by more than the stall threshold — a blocking call
+    # (a readback regression, a synchronous compile) landed on the loop.
+    "loop.stall": "asyncio serving-loop tick overshot the stall threshold",
 }
 
 

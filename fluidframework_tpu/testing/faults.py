@@ -125,6 +125,17 @@ SITES: Dict[str, str] = {
     # the events; crash-after leaves the file durable with only the
     # bookkeeping event lost.
     "journal.dump": "fallback",
+    # Serving-profiler capture arm (telemetry/profiler.py _arm — the r16
+    # timeline profiler's /profilez window): arming allocates (ring
+    # reset/resize for the bounded window), so the arm is the injectable
+    # boundary — a failed or crashed arm is counted
+    # (retry_attempts_total{profiler.arm,fallback}) and ABSORBED by
+    # arm(), which returns False so /profilez replies 503 instead of
+    # capturing; the serving path itself never sees the fault (the
+    # journal.dump contract: observability must never become the
+    # outage). Crash-after leaves the window armed — it self-disarms at
+    # the window deadline, so the capture stays bounded either way.
+    "profiler.arm": "fallback",
 }
 
 #: The recovery kinds the contract table documents. A site mapped to

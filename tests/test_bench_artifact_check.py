@@ -369,6 +369,49 @@ def test_r14_exempt_from_read_fanout_keys(tmp_path):
     assert cba.check(str(tmp_path)) == 0
 
 
+_R15_COMPLETE = dict(
+    _R14_COMPLETE,
+    serving_read_fanout_ops_per_sec=123456,
+    serving_read_delivery_p99_ms=2.5,
+    reads_per_device_dispatch=64.0,
+)
+
+
+def test_r16_requires_profiler_keys(tmp_path):
+    """An r16+ artifact must carry the timeline-profiler trio — the
+    per-boxcar host tax, the per-lane pump decomposition, AND the
+    loop-stall watchdog's lag gauge."""
+    cba = _tool()
+    _write(tmp_path, "BENCH_r16.json", [json.dumps(_R15_COMPLETE)])
+    assert cba.check(str(tmp_path)) == 1
+    # A subset of the trio is not enough.
+    _write(tmp_path, "BENCH_r16.json", [json.dumps(dict(
+        _R15_COMPLETE, serving_host_tax_ms={"p50": 0.4, "p99": 1.2},
+    ))])
+    assert cba.check(str(tmp_path)) == 1
+    _write(tmp_path, "BENCH_r16.json", [json.dumps(dict(
+        _R15_COMPLETE,
+        serving_host_tax_ms={"p50": 0.4, "p99": 1.2},
+        pump_lane_profile={"host_stage": 2.5, "loop_other": 0.7},
+    ))])
+    assert cba.check(str(tmp_path)) == 1
+    _write(tmp_path, "BENCH_r16.json", [json.dumps(dict(
+        _R15_COMPLETE,
+        serving_host_tax_ms={"p50": 0.4, "p99": 1.2},
+        pump_lane_profile={"host_stage": 2.5, "loop_other": 0.7},
+        event_loop_lag_ms=0.8,
+    ))])
+    assert cba.check(str(tmp_path)) == 0
+
+
+def test_r15_exempt_from_profiler_keys(tmp_path):
+    """Per-key since-round gating: an r15 artifact predates the
+    timeline-profiler trio and passes with the eighteen prior keys."""
+    cba = _tool()
+    _write(tmp_path, "BENCH_r15.json", [json.dumps(_R15_COMPLETE)])
+    assert cba.check(str(tmp_path)) == 0
+
+
 def test_newest_round_governs(tmp_path):
     cba = _tool()
     _write(tmp_path, "BENCH_r05.json", ['{"metric": "old"}'])

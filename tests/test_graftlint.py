@@ -894,3 +894,71 @@ def test_host_sync_flags_journal_producer_bare_transfer(tmp_path):
     )
     assert len(findings) == 1
     assert "device→host" in findings[0].message
+
+
+# -- r16 serving-profiler fixtures ---------------------------------------------
+
+
+def test_fault_site_accepts_profiler_arm_site(tmp_path):
+    """The r16 profiler capture-arm boundary: ``profiler.arm`` is in the
+    documented vocabulary (recovery: a failed arm is counted and
+    absorbed — arm() returns False and /profilez 503s; the serving path
+    never sees it), so a production module carrying the site passes
+    lint."""
+    findings = _run_pass(
+        _fault_site_pass(),
+        """
+        from fluidframework_tpu.testing.faults import inject_fault
+
+        @inject_fault("profiler.arm")
+        def arm_window(duration_ms):
+            return duration_ms
+        """,
+        tmp_path,
+    )
+    assert findings == []
+
+
+def test_fault_site_flags_unregistered_profiler_site(tmp_path):
+    """The r16 regression shape: a second profiler boundary (e.g. a
+    capture-export site) added off-vocabulary must fail lint — the
+    absorb contract only exists if the site is documented."""
+    findings = _run_pass(
+        _fault_site_pass(),
+        """
+        from fluidframework_tpu.testing.faults import inject_fault
+
+        @inject_fault("profiler.capture")
+        def export_window(path):
+            return path
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "unknown injection site" in findings[0].message
+
+
+def test_host_sync_flags_profiler_producer_bare_transfer(tmp_path):
+    """The profiler's zero-readback contract: producers record HOST
+    perf_counter timestamps only — device_step closes on the pump's
+    EXISTING one-boxcar-stale scan. A producer that runs its own
+    device→host transfer to 'time the device more precisely' is a new
+    readback on the serving path; the fixture proves the host-sync pass
+    fails it bare (no blessed pragma shape: the fix is to close on the
+    existing scan, not to annotate)."""
+    _, HostSync, *_ = _tools()
+    findings = _run_pass(
+        HostSync,
+        """
+        import numpy as np
+        import time
+
+        def profile_device_step(pool, profiler, t0):
+            # WRONG: barriers the device just to close a timing lane
+            np.asarray(pool.state.count)
+            profiler.record("device_step", t0, time.perf_counter())
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "device→host" in findings[0].message

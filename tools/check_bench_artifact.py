@@ -44,7 +44,12 @@ from typing import List, Tuple
 # throughput (asserted ≥ 5× the per-subscriber-encode baseline
 # in-bench), the per-subscriber delivery p99 across the 10k-subscriber
 # fan-out, and the batched-snapshot-gather amortization (asserted > 1
-# under concurrent load) — in r15.
+# under concurrent load) — in r15; the timeline-profiler trio — the
+# per-boxcar host tax (p50/p99 of loop_other + host_stage from one
+# captured window, the one-dispatch fusion item's justification
+# number), the per-lane pump decomposition (coverage ≥ 0.95 and the
+# device-idle reconciliation against serving_pump_device_idle_frac
+# asserted in-bench), and the loop-stall watchdog's lag gauge — in r16.
 REQUIRED = (
     ("pipeline_serving_ops_per_sec", 6),
     ("deli_scribe_e2e_ops_per_sec", 6),
@@ -64,6 +69,9 @@ REQUIRED = (
     ("serving_read_fanout_ops_per_sec", 15),
     ("serving_read_delivery_p99_ms", 15),
     ("reads_per_device_dispatch", 15),
+    ("serving_host_tax_ms", 16),
+    ("pump_lane_profile", 16),
+    ("event_loop_lag_ms", 16),
 )
 # Artifacts up to round 5 predate every gated metric.
 BASELINE_ROUND = 5
