@@ -266,7 +266,7 @@ class ShardedDoc:
             return False
         self.compact()
         single = self.to_single()
-        n = int(np.asarray(single.count))
+        n = int(np.asarray(single.count))  # graftlint: readback(rebalance is a rare host-driven redistribution — one scalar pull atop the to_single whole-doc copy it already paid for)
         if -(-max(n, 1) // self.n_shards) > self.shard_cap:
             return False  # genuinely full everywhere: ERR_CAPACITY stands
         self.load_single(single)

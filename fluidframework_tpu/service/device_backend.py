@@ -1252,6 +1252,7 @@ class DeviceFleetBackend:
         for cap, pool in self.fleet.pools.items():
             err = errs.get(cap) if errs is not None else None
             if err is None:
+                # graftlint: onloop(quiescence fallback only: the pump path always supplies the async scan's errs — this sync pull runs when a pool is missing from it, i.e. the explicit collect_now barrier after ingest went quiet)
                 err = np.asarray(pool.state.err)  # graftlint: readback(synchronous fallback when no async scan was supplied — collect_now contract)
             if len(err) < pool.n_slots:
                 err = np.concatenate(
@@ -1359,6 +1360,7 @@ class DeviceFleetBackend:
             states.update(token["fallback"])
         elif token["dev"] is not None:
             if host is None:
+                # graftlint: onloop(sync fallback when the caller passes no prefetched host copy — the network server's batched REST path always runs read_transfer in the executor; direct callers are tests/bench with no loop to stall)
                 host = self.read_transfer(token["dev"])
             states.update(
                 DocFleet.doc_states_finish(host, token["layout"])
@@ -1507,6 +1509,7 @@ class DeviceFleetBackend:
         dark), all in ONE batched readback — the /metrics contract — plus
         the host-side commit totals that need no device round trip."""
         dev, layout, totals = self._telemetry_start()
+        # graftlint: onloop(sync scrape fallback for the store node and bench — no event loop to stall; the websocket front door always scrapes via the _telemetry_readback off-loop split)
         return self._telemetry_finish(
             self._telemetry_readback(dev), layout, totals
         )

@@ -30,7 +30,9 @@ import asyncio
 import hashlib
 import hmac
 import json
+import queue as queue_mod
 import secrets
+import select
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -89,6 +91,14 @@ class _Session:
         # group's minimum watermark back.
         self.push_tail: list = []
         self.frames_ok = False  # client negotiated the binary frame wire
+        # The r17 writer-loop offload: once a push subscriber's raw
+        # socket is attached (transport buffer drained), its byte
+        # writes run on the drainer thread — push_busy marks a batch
+        # in flight there, and the fan-out sweep skips the session
+        # until the drainer clears it (watermark/tail updates happen on
+        # the drainer; the loop reads them only when not busy).
+        self.push_sock = None
+        self.push_busy = False
 
 
 class _PushEncodeCache:
@@ -137,6 +147,165 @@ class _PushEncodeCache:
                 wsproto.OP_BINARY, entry[2].encode()
             )
         return got
+
+
+class _PushStall(Exception):
+    """A bounded-write timeout after ``sent`` bytes of the payload
+    reached the kernel. The partial prefix is ON THE WIRE — recovery
+    must resume from ``data[sent:]``, never resend the whole payload
+    (a whole-frame resend after a partial prefix tears the websocket
+    stream unrecoverably)."""
+
+    def __init__(self, sent: int, timeout_s: float):
+        super().__init__(f"push write stalled past {timeout_s}s "
+                         f"({sent} bytes already sent)")
+        self.sent = sent
+
+
+def _sock_sendall(sock, data: bytes, timeout_s: float) -> None:
+    """Blocking-with-bound sendall on asyncio's non-blocking socket:
+    spin send/select until the payload is fully written or the
+    per-write stall bound expires. The bound is the r15 stalled-
+    subscriber contract made real at the byte layer — a subscriber
+    whose kernel buffer stays full for ``timeout_s`` raises
+    :class:`_PushStall` (carrying how much of the payload already
+    reached the wire, so the requeue resumes mid-payload), and the
+    drainer moves on instead of parking behind one slow socket."""
+    view = memoryview(data)
+    sent = 0
+    deadline = time.monotonic() + timeout_s
+    while view:
+        try:
+            n = sock.send(view)  # graftlint: onloop(drainer-owned socket write — the loop reaches this only through the post-stop inline fallback where no drainer runs; live serving always crosses the drainer thread)
+            view = view[n:]
+            sent += n
+        except (BlockingIOError, InterruptedError):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise _PushStall(sent, timeout_s)
+            select.select([], [sock], [], min(remaining, 0.05))  # graftlint: onloop(bounded writability wait on the drainer thread — same post-stop-only loop reachability as the send above)
+
+
+class _PushDrainer:
+    """The r17 writer-loop offload (ROADMAP read-path remainder): push
+    fan-out byte WRITES run on one daemon drainer thread, so the
+    asyncio loop spends its time forming boxcars and reading sockets
+    instead of copying the same encoded bytes into N kernel buffers.
+    The encode-once sweep (grouping, the shared log read, the encode
+    cache) stays ON the loop where it is serialized with service state;
+    only ``_push_send`` batches — already-encoded ``(seq, bytes, is
+    frame)`` payloads — cross to the drainer.
+
+    Delivery semantics are unchanged by construction: the drainer runs
+    the SAME ``_push_send_sync`` body (the ``push.fanout`` injection
+    boundary included), one thread + one FIFO queue preserves
+    per-subscriber payload order, and ``push_busy`` keeps the loop from
+    reading a session's watermark/tail (or enqueueing more work) while
+    a batch is in flight — so the r11 exactly-once crash-after rule and
+    the requeue-tail recovery hold verbatim, now chaos-matrix-pinned
+    from the drainer thread."""
+
+    _STOP = object()
+
+    def __init__(self, server: "FluidNetworkServer"):
+        self._server = server
+        # queue.Queue (not SimpleQueue): its task_done()/unfinished
+        # accounting is lock-protected, which is what makes join() a
+        # sound cross-thread barrier.
+        self._q: queue_mod.Queue = queue_mod.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self.batches = 0  # processed batches (tests/bench read these)
+        self.threads: set = set()  # ident(s) that ran writes
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> None:
+        if self.alive:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="push-drainer", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if not self.alive:
+            return
+        self._q.put(self._STOP)
+        self._thread.join(5)
+        self._thread = None
+
+    def submit(self, session: "_Session", payloads: list) -> None:
+        """Hand one subscriber's encoded batch to the drainer. Caller
+        (the fan-out sweep, on the loop) must not touch the session's
+        push state again until ``push_busy`` clears."""
+        session.push_busy = True
+        self._q.put((session, payloads))
+
+    def submit_control(self, session: "_Session", data: bytes) -> None:
+        """Queue a control-frame write (pong, control-plane reply)
+        behind the session's op stream WITHOUT the busy/watermark
+        machinery: control bytes touch no push state, so they must not
+        make the fan-out sweep skip the session they just woke (the
+        sweep runs right after the ping is processed)."""
+        self._q.put((session, data))
+
+    def join(self, timeout_s: float = 5.0) -> bool:
+        """Wait until every submitted batch has been processed (tests
+        and the bench's per-round measurement barrier). Rides the
+        queue's lock-protected unfinished-task count."""
+        deadline = time.monotonic() + timeout_s
+        while self._q.unfinished_tasks > 0:
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(0.0005)
+        return True
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is self._STOP:
+                self._q.task_done()
+                return
+            session, payloads = item
+            try:
+                self.threads.add(threading.get_ident())
+                if isinstance(payloads, bytes):
+                    # Control write (pong): bytes only, no push state.
+                    if session.push_sock is not None:
+                        _sock_sendall(
+                            session.push_sock,
+                            payloads,
+                            self._server.PUSH_WRITE_TIMEOUT_S,
+                        )
+                else:
+                    self._server._push_send_sync(session, payloads)
+            except Exception:
+                # The write body already converts failures into requeue
+                # tails; anything else (a torn-down session, a stalled
+                # pong) must not kill the drainer for every other
+                # subscriber.
+                pass
+            finally:
+                if not isinstance(payloads, bytes):
+                    session.push_busy = False
+                    # Follow-up sweep on the loop: ops that became
+                    # durable while this batch was in flight were
+                    # busy-skipped — without this, a then-quiet server
+                    # would sit on them until arbitrary new inbound
+                    # traffic. Converges: a sweep with nothing past the
+                    # watermarks enqueues no batch, so no follow-up.
+                    loop = self._server._loop
+                    if loop is not None and not loop.is_closed():
+                        try:
+                            loop.call_soon_threadsafe(
+                                self._server._push_sweep
+                            )
+                        except RuntimeError:
+                            pass  # loop shutting down
+                self.batches += 1
+                self._q.task_done()
 
 
 class FluidNetworkServer:
@@ -205,6 +374,12 @@ class FluidNetworkServer:
         self._pending_reads: list = []
         self._reads_scheduled = False
         self.read_batches = 0
+        # The r17 writer-loop offload: push byte writes drain on this
+        # thread once the server is running (ROADMAP read-path
+        # remainder). A server that never starts (in-proc tests driving
+        # _drain_all directly) keeps the synchronous inline path —
+        # same body, same semantics.
+        self._push_drainer = _PushDrainer(self)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -233,6 +408,7 @@ class FluidNetworkServer:
             from fluidframework_tpu.telemetry import profiler
 
             profiler.install_gc_hooks()
+            self._push_drainer.start()
             self._started.set()
 
         self._loop.run_until_complete(boot())
@@ -262,6 +438,7 @@ class FluidNetworkServer:
         asyncio.run_coroutine_threadsafe(shutdown(), self._loop)
         if self._thread is not None:
             self._thread.join(5)
+        self._push_drainer.stop()
 
     # -- connection handling ------------------------------------------------
 
@@ -871,9 +1048,21 @@ class FluidNetworkServer:
                     if opcode == wsproto.OP_CLOSE:
                         return
                     if opcode == wsproto.OP_PING:
-                        writer.write(
-                            wsproto.encode_frame(wsproto.OP_PONG, payload)
+                        pong = wsproto.encode_frame(
+                            wsproto.OP_PONG, payload
                         )
+                        if session.push_sock is not None:
+                            # Drainer-owned socket: the pong must ride
+                            # the drainer queue too — a transport write
+                            # racing a raw send could interleave
+                            # mid-frame. Control writes skip the busy
+                            # flag so the sweep this ping triggers
+                            # still delivers to this session.
+                            self._push_drainer.submit_control(
+                                session, pong
+                            )
+                        else:
+                            writer.write(pong)
                         continue
                     if opcode == wsproto.OP_BINARY:
                         # Batched binary op wire (protocol/opframe.py):
@@ -902,11 +1091,17 @@ class FluidNetworkServer:
             session.conn = None
 
     def _send(self, session: _Session, obj: dict) -> None:
-        session.writer.write(
-            wsproto.encode_frame(
-                wsproto.OP_TEXT, json.dumps(obj).encode()
-            )
+        data = wsproto.encode_frame(
+            wsproto.OP_TEXT, json.dumps(obj).encode()
         )
+        if session.push_sock is not None:
+            # Drainer-owned socket: EVERY loop-side write (error
+            # replies to a repeat subscribe/connect included) must ride
+            # the drainer queue — a transport write racing a raw send
+            # would interleave mid-frame.
+            self._push_drainer.submit_control(session, data)
+        else:
+            session.writer.write(data)
 
     @inject_fault("ws.deliver")
     def _deliver(self, session: _Session, data: bytes) -> None:
@@ -948,14 +1143,25 @@ class FluidNetworkServer:
 
     # -- the encode-once push fan-out (r15) ----------------------------------
 
+    #: Per-write stall bound for drainer-thread socket writes: a
+    #: subscriber whose kernel buffer stays full this long requeues its
+    #: already-encoded tail instead of parking the drainer.
+    PUSH_WRITE_TIMEOUT_S = 0.25
+
     @inject_fault("push.fanout")
     def _push_write(self, session: _Session, data: bytes) -> None:
         """One fan-out delivery write of shared pre-encoded bytes — the
-        ``push.fanout`` injection boundary. Recovery: the failed
-        subscriber's remaining ALREADY-ENCODED payloads requeue as its
-        tail (``_push_send``); every other subscriber in the group keeps
-        draining the same bytes."""
-        session.writer.write(data)
+        ``push.fanout`` injection boundary, on whichever thread runs
+        the batch (the drainer once the raw socket is attached; the
+        loop inline otherwise). Recovery: the failed subscriber's
+        remaining ALREADY-ENCODED payloads requeue as its tail
+        (``_push_send_sync``); every other subscriber in the group
+        keeps draining the same bytes."""
+        sock = session.push_sock
+        if sock is not None:
+            _sock_sendall(sock, data, self.PUSH_WRITE_TIMEOUT_S)
+        else:
+            session.writer.write(data)
 
     #: Catch-up window per (subscriber-group, sweep): a cold subscriber
     #: (e.g. subscribe_push from_seq=0 against a deep log) streams the
@@ -963,6 +1169,19 @@ class FluidNetworkServer:
     #: whole log on the event loop — and instead of dragging the shared
     #: group read back for every caught-up subscriber.
     PUSH_CATCHUP_SPAN = 4096
+
+    def _push_sweep(self) -> None:
+        """One push fan-out sweep over every subscriber group — called
+        from every ``_drain_all`` AND scheduled by the drainer when a
+        batch completes (the loop-side half of the r17 offload: a
+        busy-skipped session's pending ops deliver without waiting for
+        new inbound traffic)."""
+        push_groups: Dict[str, List[_Session]] = {}
+        for s in self._sessions:
+            if s.push_doc is not None:
+                push_groups.setdefault(s.push_doc, []).append(s)
+        for doc_id, subs in push_groups.items():
+            self._push_fanout(doc_id, subs)
 
     def _push_fanout(self, doc_id: str, subs: List["_Session"]) -> None:
         """Deliver newly durable ops to every push subscriber of one doc:
@@ -976,9 +1195,16 @@ class FluidNetworkServer:
         point) and converge on the shared read over later sweeps."""
         live = []
         for s in subs:
+            if s.push_busy:
+                # A batch is in flight on the drainer: the session's
+                # watermark/tail belong to that thread until it clears.
+                # Like a tailed subscriber, a busy one never drags the
+                # group's minimum watermark back — the next sweep picks
+                # it up where the drainer left it.
+                continue
             if s.push_tail:
                 self._push_deliver_tail(s)
-            if not s.push_tail:
+            if not s.push_tail and not s.push_busy:
                 live.append(s)
         if not live:
             return
@@ -1070,11 +1296,56 @@ class FluidNetworkServer:
         self._push_send(s, payloads)
 
     def _push_send(self, s: "_Session", payloads: list) -> None:
+        """Route one subscriber's pending payloads: onto the drainer
+        thread when it runs and the session's raw socket is attached
+        (the r17 writer-loop offload — the loop enqueues and moves to
+        the next subscriber), inline otherwise (unstarted servers,
+        duck-typed writers, and the handshake window while the
+        transport buffer drains). Either way the batch runs
+        ``_push_send_sync`` — one body, one contract."""
+        if not payloads:
+            return
+        dr = self._push_drainer
+        if dr.alive and self._attach_push_sock(s):
+            dr.submit(s, payloads)
+        else:
+            self._push_send_sync(s, payloads)
+
+    def _attach_push_sock(self, s: "_Session") -> bool:
+        """Attach the session's raw socket for drainer writes, once the
+        asyncio transport has nothing buffered (mixing transport writes
+        with raw sends would interleave mid-frame — the
+        subscribe_push_success reply must fully flush first). Returns
+        True when drainer writes are safe."""
+        if s.push_sock is not None:
+            return True
+        tr = getattr(s.writer, "transport", None)
+        if tr is None:
+            return False  # duck-typed writer: stay inline
+        try:
+            if tr.get_write_buffer_size() > 0:
+                return False  # handshake bytes still draining
+            sock = tr.get_extra_info("socket")
+        except Exception:
+            return False
+        if sock is None:
+            return False
+        # asyncio hands out a TransportSocket wrapper whose send()
+        # methods are deprecated-then-removed across CPython versions —
+        # unwrap the real socket (same fd, no dup) for drainer writes.
+        s.push_sock = getattr(sock, "_sock", sock)
+        return True
+
+    def _push_send_sync(self, s: "_Session", payloads: list) -> None:
         """Write one subscriber's pending payloads in seq order. The
         watermark advances per successful write (or past a crash-AFTER
         write — it reached the socket; redelivering would double-send:
         the r11 ws exactly-once rule); everything unsent requeues as the
-        subscriber's tail for the next sweep."""
+        subscriber's tail for the next sweep. A bounded-write stall
+        that left a PARTIAL payload on the wire requeues the payload's
+        unsent SUFFIX bytes (same seq, same wire position) — resending
+        the whole payload after a delivered prefix would tear the
+        subscriber's frame stream."""
         for j, (seq, data, binary) in enumerate(payloads):
             try:
                 self._push_write(s, data)
@@ -1085,6 +1356,15 @@ class FluidNetworkServer:
                 if completed:
                     s.push_seq = max(s.push_seq, seq)
                 tail = payloads[j + 1:] if completed else payloads[j:]
+                if (
+                    isinstance(e, _PushStall)
+                    and e.sent > 0
+                    and not completed
+                ):
+                    # Resume THIS payload mid-byte: its prefix reached
+                    # the kernel; the watermark stays below seq until
+                    # the suffix lands.
+                    tail = [(seq, data[e.sent:], binary)] + payloads[j + 1:]
                 if tail:
                     s.push_tail = tail
                     retry.retry_counter().inc(
@@ -1269,12 +1549,7 @@ class FluidNetworkServer:
         # past their watermark. Per-subscriber state is a watermark + a
         # requeue tail — the r11 exactly-once crash-after semantics per
         # socket are unchanged.
-        push_groups: Dict[str, List[_Session]] = {}
-        for s in self._sessions:
-            if s.push_doc is not None:
-                push_groups.setdefault(s.push_doc, []).append(s)
-        for doc_id, subs in push_groups.items():
-            self._push_fanout(doc_id, subs)
+        self._push_sweep()
         for s in self._sessions:
             if s.conn is None:
                 continue

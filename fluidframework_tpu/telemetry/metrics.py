@@ -37,6 +37,48 @@ DEFAULT_BUCKETS_MS: Tuple[float, ...] = (
 # skewed stamp must not put ~1e12 into a histogram sum.
 SPAN_SANITY_MS = 600_000.0
 
+# ---------------------------------------------------------------------------
+# Family vocabulary: every Prometheus family a production module may
+# register, with its kind — the ``faults.SITES`` discipline applied to
+# the exposition surface. The graftlint ``vocab-drift`` pass parses this
+# dict STATICALLY and cross-checks it against every
+# ``reg.counter/gauge/histogram("<family>", ...)`` registration in the
+# package: an undeclared family, a kind mismatch, or a declared family
+# nothing registers (a dead dashboard row) fails CI. Scrape consumers
+# (dashboards, the autoscaler, check_bench_artifact) can therefore trust
+# this table as THE exposition contract.
+
+FAMILIES: Dict[str, str] = {
+    # -- admission / overload (r13) -----------------------------------------
+    "admission_denied_total": "counter",
+    "admission_tokens": "gauge",
+    "overload_shed_total": "counter",
+    "serving_overload_tier": "gauge",
+    "serving_overload_tier_transitions_total": "counter",
+    # -- device backend / read tier (r10/r15) -------------------------------
+    "device_backend_totals": "gauge",
+    "device_shard_telemetry": "gauge",
+    "reads_per_device_dispatch": "gauge",
+    "read_cache_hits_total": "counter",
+    "read_cache_misses_total": "counter",
+    # -- chaos / recovery (r11) ---------------------------------------------
+    "faults_injected_total": "counter",
+    "retry_attempts_total": "counter",
+    # -- flight recorder / profiler / watchdogs (r14/r16) -------------------
+    "journal_dumps_total": "counter",
+    "event_loop_lag_ms": "gauge",
+    "gc_pause_ms": "histogram",
+    "gc_pauses_total": "counter",
+    # -- trace spine / stage spans (r9) -------------------------------------
+    "serving_stage_ms": "histogram",
+    "trace_frames_dropped_total": "counter",
+    "tree_ingest_commits_total": "counter",
+    # -- lumber / store node ------------------------------------------------
+    "lumber_events_total": "counter",
+    "lumber_duration_ms": "histogram",
+    "store_requests_total": "counter",
+}
+
 _LabelKey = Tuple[Tuple[str, str], ...]
 
 

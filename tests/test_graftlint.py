@@ -938,6 +938,642 @@ def test_fault_site_flags_unregistered_profiler_site(tmp_path):
     assert "unknown injection site" in findings[0].message
 
 
+# -- r17 loop-blocking ---------------------------------------------------------
+
+
+def _loop_blocking_pass():
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.graftlint.passes import LoopBlockingPass
+
+    return LoopBlockingPass
+
+
+def test_loop_blocking_flags_sleep_and_transfer_in_coroutine(tmp_path):
+    """TP: a time.sleep directly in a coroutine and a device readback in
+    a sync helper the coroutine calls — both reachable from the loop,
+    both flagged, the transitive path named in the message."""
+    findings = _run_pass(
+        _loop_blocking_pass(),
+        """
+        import time
+        import numpy as np
+
+        class S:
+            async def ticker(self):
+                time.sleep(0.01)
+                self._step()
+
+            def _step(self):
+                return np.asarray(self.pool.state.err)
+        """,
+        tmp_path,
+    )
+    msgs = sorted(f.message for f in findings)
+    assert len(findings) == 2, msgs
+    assert any("time.sleep" in m for m in msgs)
+    assert any(
+        "device→host" in m and "ticker -> _step" in m for m in msgs
+    )
+
+
+def test_loop_blocking_off_loop_split_is_clean(tmp_path):
+    """TN: the sanctioned pattern — the blocking transfer half runs via
+    run_in_executor (the scan_transfer split); the off-loop helper's own
+    np.asarray is NOT on-loop reachable."""
+    findings = _run_pass(
+        _loop_blocking_pass(),
+        """
+        import asyncio
+        import numpy as np
+
+        class S:
+            async def tick(self, dev_backend):
+                token = dev_backend.prefetch()
+                loop = asyncio.get_running_loop()
+                host = await loop.run_in_executor(
+                    None, self.scan_transfer, token
+                )
+                return host
+
+            @staticmethod
+            def scan_transfer(token):
+                return np.asarray(token.dev)
+        """,
+        tmp_path,
+    )
+    assert findings == []
+
+
+def test_loop_blocking_flags_direct_off_loop_helper_call(tmp_path):
+    """TP: calling a declared off-loop half synchronously from a
+    coroutine defeats the split — flagged by name."""
+    findings = _run_pass(
+        _loop_blocking_pass(),
+        """
+        class S:
+            async def tick(self):
+                return self.scan_transfer(self._token)
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "off-loop helper scan_transfer()" in findings[0].message
+
+
+def test_loop_blocking_loop_entry_roots_apply(tmp_path):
+    """The cross-module on-loop contract: device_backend's ``flush`` is
+    a configured LOOP_ENTRY root — a blocking op inside it is flagged
+    with no async def in sight (network_server's loop calls it)."""
+    findings = _run_pass(
+        _loop_blocking_pass(),
+        """
+        import time
+
+        class Backend:
+            def flush(self):
+                time.sleep(0.001)
+        """,
+        tmp_path,
+        relpath="fluidframework_tpu/service/device_backend.py",
+    )
+    assert len(findings) == 1
+    assert "time.sleep" in findings[0].message
+
+
+def test_loop_blocking_onloop_pragma_suppresses_with_reason(tmp_path):
+    snippet = """
+    import numpy as np
+
+    class S:
+        async def drain(self):
+            {pragma}
+            err = np.asarray(self.pool.state.err)
+            return err
+    """
+    bare = _run_pass(
+        _loop_blocking_pass(), snippet.format(pragma="pass"), tmp_path
+    )
+    assert len(bare) == 1
+    annotated = _run_pass(
+        _loop_blocking_pass(),
+        snippet.format(
+            pragma="# graftlint: onloop(quiescence barrier — runs only "
+            "after ingest went quiet)"
+        ),
+        tmp_path,
+    )
+    assert annotated == []
+
+
+def test_loop_blocking_unbounded_lock_acquire(tmp_path):
+    """TP: a bare .acquire() on a lock parks the loop behind any
+    producer thread; TN: a timeout-bounded acquire."""
+    findings = _run_pass(
+        _loop_blocking_pass(),
+        """
+        class S:
+            async def handle(self):
+                self._lock.acquire()
+                try:
+                    return 1
+                finally:
+                    self._lock.release()
+
+            async def bounded(self):
+                return self._lock.acquire(timeout=0.1)
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "unbounded Lock.acquire" in findings[0].message
+
+
+# -- r17 lock-order ------------------------------------------------------------
+
+
+def _lock_order_pass():
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.graftlint.passes import LockOrderPass
+
+    return LockOrderPass
+
+
+def _run_lock_order(snippet, tmp_path, relpath="fluidframework_tpu/service/x.py"):
+    core = _tools()[0]
+    abspath = tmp_path / "snippet.py"
+    abspath.write_text(textwrap.dedent(snippet))
+    src = core.ModuleSource.load(str(tmp_path), "snippet.py")
+    src.path = relpath
+    p = _lock_order_pass()()
+    run_findings = [
+        f for f, node in p.run(src) if not src.suppressed(f, node)
+    ]
+    return run_findings, p.finalize()
+
+
+def test_lock_order_cycle_detected(tmp_path):
+    """TP: two code paths taking the same two locks in opposite order —
+    the classic deadlock — is a cycle in the aggregated graph."""
+    run_f, cycles = _run_lock_order(
+        """
+        class A:
+            def f(self):
+                with self._lock:
+                    with self._ring_lock:
+                        pass
+
+            def g(self):
+                with self._ring_lock:
+                    with self._lock:
+                        pass
+        """,
+        tmp_path,
+    )
+    assert run_f == []
+    assert len(cycles) == 1
+    assert "lock-order cycle" in cycles[0].message
+    assert "A._lock" in cycles[0].message
+    assert "A._ring_lock" in cycles[0].message
+
+
+def test_lock_order_consistent_order_is_clean(tmp_path):
+    """TN: the same nesting everywhere is an ordered pair — edges, but
+    no cycle."""
+    run_f, cycles = _run_lock_order(
+        """
+        class A:
+            def f(self):
+                with self._lock:
+                    with self._ring_lock:
+                        pass
+
+            def g(self):
+                with self._lock:
+                    with self._ring_lock:
+                        pass
+        """,
+        tmp_path,
+    )
+    assert run_f == []
+    assert cycles == []
+
+
+def test_lock_order_interprocedural_cycle(tmp_path):
+    """The cycle hides behind a call: f holds L and calls helper (which
+    takes M); g nests the other way. Still detected via the per-function
+    acquire closures."""
+    run_f, cycles = _run_lock_order(
+        """
+        class A:
+            def f(self):
+                with self._lock:
+                    self._helper()
+
+            def _helper(self):
+                with self._ring_lock:
+                    pass
+
+            def g(self):
+                with self._ring_lock:
+                    with self._lock:
+                        pass
+        """,
+        tmp_path,
+    )
+    assert len(cycles) == 1
+
+
+def test_lock_order_gc_callback_taking_lock_fails(tmp_path):
+    """TP: the exact r16 deadlock shape — a gc.callbacks hook that
+    acquires a lock (directly or via a metric inc) fails lint."""
+    run_f, _ = _run_lock_order(
+        """
+        import gc
+
+        def _cb(phase, info):
+            with _LOCK:
+                pass
+
+        gc.callbacks.append(_cb)
+        """,
+        tmp_path,
+        relpath="fluidframework_tpu/telemetry/x.py",
+    )
+    assert len(run_f) == 1
+    assert "must be lock-free by contract" in run_f[0].message
+
+    run_f2, _ = _run_lock_order(
+        """
+        import gc
+
+        def _cb(phase, info):
+            pause_counter().inc(gen="0")
+
+        gc.callbacks.append(_cb)
+        """,
+        tmp_path,
+        relpath="fluidframework_tpu/telemetry/x.py",
+    )
+    assert len(run_f2) == 1
+    assert "_Metric._lock" in run_f2[0].message
+
+
+def test_lock_order_buffering_gc_callback_is_clean(tmp_path):
+    """TN: the production contract — the callback only appends to a
+    plain list (GIL-atomic) and normal code drains it."""
+    run_f, cycles = _run_lock_order(
+        """
+        import gc
+        import time
+
+        _PENDING = []
+
+        def _cb(phase, info):
+            _PENDING.append((time.perf_counter(), info.get("generation")))
+
+        gc.callbacks.append(_cb)
+        """,
+        tmp_path,
+        relpath="fluidframework_tpu/telemetry/x.py",
+    )
+    assert run_f == [] and cycles == []
+
+
+def test_lock_order_render_path_nested_hold_fails(tmp_path):
+    """TP: a render path acquiring a second lock while holding one —
+    the shape the r16 hardening removed (snapshot under the lock,
+    render outside it)."""
+    run_f, _ = _run_lock_order(
+        """
+        class MetricsRegistry:
+            def render(self):
+                with self._lock:
+                    for m in self._metrics.values():
+                        with m._lock:
+                            pass
+        """,
+        tmp_path,
+        relpath="fluidframework_tpu/telemetry/metrics.py",
+    )
+    assert len(run_f) == 1
+    assert "ONE lock at a time" in run_f[0].message
+
+
+def test_lock_order_self_deadlock(tmp_path):
+    run_f, _ = _run_lock_order(
+        """
+        class A:
+            def f(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+        """,
+        tmp_path,
+    )
+    assert len(run_f) == 1
+    assert "self-deadlock" in run_f[0].message
+
+
+def test_lock_order_pragma_suppresses_with_reason(tmp_path):
+    run_f, _ = _run_lock_order(
+        """
+        class MetricsRegistry:
+            def render(self):
+                with self._lock:
+                    # graftlint: lockorder(m is registry-private: no other path holds m._lock without the registry lock)
+                    with self._m._lock:
+                        pass
+        """,
+        tmp_path,
+        relpath="fluidframework_tpu/telemetry/metrics.py",
+    )
+    assert run_f == []
+
+
+# -- r17 vocab-drift ------------------------------------------------------------
+
+
+def _vocab_pass():
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.graftlint.passes import VocabDriftPass
+
+    return VocabDriftPass
+
+
+def test_vocab_drift_flags_undeclared_journal_kind(tmp_path):
+    findings = _run_pass(
+        _vocab_pass(),
+        """
+        from fluidframework_tpu.telemetry import journal
+
+        def submit(doc):
+            journal.record("frame.submitted", doc=doc)
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "undeclared journal event kind 'frame.submitted'" in (
+        findings[0].message
+    )
+
+
+def test_vocab_drift_accepts_declared_kinds_and_conditional(tmp_path):
+    """TN: declared kinds pass, including the two-literal conditional
+    shape the admission path uses."""
+    findings = _run_pass(
+        _vocab_pass(),
+        """
+        from fluidframework_tpu.telemetry import journal, profiler
+
+        def submit(doc, admitted):
+            journal.record("frame.submit", doc=doc)
+            journal.record(
+                "admission.admit" if admitted else "admission.deny",
+                doc=doc,
+            )
+            profiler.record("host_stage", 0.0, 1.0)
+        """,
+        tmp_path,
+    )
+    assert findings == []
+
+
+def test_vocab_drift_flags_undeclared_profiler_lane(tmp_path):
+    findings = _run_pass(
+        _vocab_pass(),
+        """
+        from fluidframework_tpu.telemetry import profiler
+
+        def step(t0, t1):
+            profiler.record("device_wait", t0, t1)
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "undeclared profiler lane 'device_wait'" in findings[0].message
+
+
+def test_vocab_drift_flags_non_literal_kind(tmp_path):
+    findings = _run_pass(
+        _vocab_pass(),
+        """
+        from fluidframework_tpu.telemetry import journal
+
+        def submit(kind, doc):
+            journal.record(kind, doc=doc)
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "string literal" in findings[0].message
+
+
+def test_vocab_drift_flags_unknown_stage_literal(tmp_path):
+    findings = _run_pass(
+        _vocab_pass(),
+        """
+        from fluidframework_tpu.telemetry import tracing
+
+        def handle(traces):
+            tracing.stamp(traces, "alfredo", "start")
+            tracing.stamp(traces, "alfred", "end")
+            tracing.stamp(traces, tracing.STAGE_DELI, "start")
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "'alfredo'" in findings[0].message
+
+
+def test_vocab_drift_family_checks(tmp_path):
+    """Undeclared family, kind mismatch, and non-literal name all fail;
+    a declared registration passes."""
+    findings = _run_pass(
+        _vocab_pass(),
+        """
+        from fluidframework_tpu.telemetry import metrics
+
+        def register(reg, name):
+            ok = reg.counter("retry_attempts_total", "x", ("site",))
+            bad_name = reg.counter("my_new_total", "x")
+            bad_kind = reg.gauge("retry_attempts_total", "x")
+            non_literal = reg.counter(name, "x")
+            return ok, bad_name, bad_kind, non_literal
+        """,
+        tmp_path,
+    )
+    msgs = sorted(f.message for f in findings)
+    assert len(findings) == 3, msgs
+    assert any("undeclared Prometheus family 'my_new_total'" in m for m in msgs)
+    assert any("one family, one kind" in m for m in msgs)
+    assert any("must be a string literal" in m for m in msgs)
+
+
+def test_vocab_drift_dead_fault_site(tmp_path):
+    """The DEAD direction: a site declared in the vocabulary that no
+    production boundary decorates fails via finalize()."""
+    core = _tools()[0]
+    vocab_dir = tmp_path / "fluidframework_tpu" / "testing"
+    vocab_dir.mkdir(parents=True)
+    (vocab_dir / "faults.py").write_text(
+        'SITES = {"store.append": "retry", "store.ghost": "retry"}\n'
+        'RECOVERY_KINDS = frozenset({"retry"})\n'
+    )
+    mod_dir = tmp_path / "fluidframework_tpu" / "service"
+    mod_dir.mkdir(parents=True)
+    (mod_dir / "m.py").write_text(textwrap.dedent(
+        """
+        from fluidframework_tpu.testing.faults import inject_fault
+
+        @inject_fault("store.append")
+        def append(log, frame):
+            log.append(frame)
+        """
+    ))
+    p = _vocab_pass()()
+    p.scope(str(tmp_path))
+    src = core.ModuleSource.load(
+        str(tmp_path), "fluidframework_tpu/service/m.py"
+    )
+    run_findings = list(p.run(src))
+    assert run_findings == []
+    dead = [
+        f for f in p.finalize()
+        if "dead fault site" in f.message
+    ]
+    assert len(dead) == 1
+    assert "'store.ghost'" in dead[0].message
+
+
+def test_vocab_drift_repo_vocabularies_have_no_dead_entries():
+    """The real repo: run the pass over its whole scope; finalize must
+    find nothing dead (every site/kind/lane/stage/family has a live
+    producer) — the CI invariant behind the empty baseline."""
+    core = _tools()[0]
+    p = _vocab_pass()()
+    findings = []
+    for rel in p.scope(REPO):
+        src = core.ModuleSource.load(REPO, rel)
+        findings.extend(f for f, _n in p.run(src))
+    findings.extend(p.finalize())
+    assert findings == [], [f.render() for f in findings]
+
+
+# -- r17 stale pragmas + output formats ----------------------------------------
+
+
+def test_stale_pragma_reported_and_live_pragma_kept(tmp_path):
+    """A reasoned pragma whose finding no longer fires is itself a
+    finding; a pragma still suppressing something is not."""
+    core = _tools()[0]
+    pkg = tmp_path / "fluidframework_tpu" / "parallel"
+    pkg.mkdir(parents=True)
+    (pkg / "fleet.py").write_text(textwrap.dedent(
+        """
+        import numpy as np
+
+        def live(pool):
+            return np.asarray(pool.state.err)  # graftlint: readback(explicit health pull)
+
+        def stale(rows):
+            return np.asarray(rows)  # graftlint: readback(this suppresses nothing)
+        """
+    ))
+    findings, _ = core.run(
+        str(tmp_path), passes=["host-sync"], use_baseline=False
+    )
+    assert [f.rule for f in findings] == ["stale-pragma"], [
+        f.render() for f in findings
+    ]
+    assert findings[0].line == 8
+
+
+def test_stale_pragma_not_reported_when_pass_not_selected(tmp_path):
+    """A pragma is only stale when its OWN pass looked: running just the
+    determinism pass must not call host-sync pragmas stale."""
+    core = _tools()[0]
+    pkg = tmp_path / "fluidframework_tpu" / "tree"
+    pkg.mkdir(parents=True)
+    (pkg / "m.py").write_text(
+        "import numpy as np\n"
+        "def f(rows):\n"
+        "    return np.asarray(rows)  # graftlint: readback(unrelated)\n"
+    )
+    findings, _ = core.run(
+        str(tmp_path), passes=["determinism"], use_baseline=False
+    )
+    assert findings == []
+
+
+def test_repo_has_no_stale_pragmas():
+    """The sweep satellite: the merged tree's reasoned-exception set is
+    fully live (explicit --stale-pragmas mode exits 0)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--check",
+         "--stale-pragmas"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_json_output_shape():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--check",
+         "--format=json", "--timings"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["tool"] == "graftlint"
+    assert doc["findings"] == []
+    assert doc["stale_baseline_entries"] == []
+    assert set(doc["pass_seconds"]) == {
+        "host-sync", "recompile-hazard", "determinism", "fault-site",
+        "wire-drift", "loop-blocking", "lock-order", "vocab-drift",
+    }
+
+
+def test_sarif_output_shape(tmp_path):
+    """SARIF renders findings with ruleId + physical location (drive it
+    through a fixture repo so there IS a finding)."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.graftlint.__main__ import _as_sarif
+
+    core = _tools()[0]
+    f = core.Finding(
+        rule="loop-blocking", path="fluidframework_tpu/service/x.py",
+        line=12, col=3, message="time.sleep blocks the event loop",
+    )
+    doc = _as_sarif([f])
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    res = run["results"][0]
+    assert res["ruleId"] == "loop-blocking"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "fluidframework_tpu/service/x.py"
+    assert loc["region"]["startLine"] == 12
+
+
+def test_all_eight_passes_registered():
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.graftlint.passes import ALL_PASSES
+
+    assert [p.id for p in ALL_PASSES] == [
+        "host-sync", "recompile-hazard", "determinism", "fault-site",
+        "wire-drift", "loop-blocking", "lock-order", "vocab-drift",
+    ]
+
+
 def test_host_sync_flags_profiler_producer_bare_transfer(tmp_path):
     """The profiler's zero-readback contract: producers record HOST
     perf_counter timestamps only — device_step closes on the pump's

@@ -704,6 +704,210 @@ class TestHistorianReadTier:
 
 
 # ---------------------------------------------------------------------------
+# The r17 writer-loop offload: push byte writes on the drainer thread
+
+
+class TestWriterLoopOffload:
+    """ROADMAP read-path remainder, shipped r17: once a push
+    subscriber's raw socket is attached, its byte writes run on the
+    server's drainer thread — the asyncio loop only forms/encodes. The
+    r11/r15 exactly-once and requeue-tail contracts are re-pinned here
+    THROUGH the drainer (the push.fanout matrix now injects on the
+    drainer thread)."""
+
+    def _drive(self, srv, sock, dec, want_n, deadline_s=15.0):
+        """Read delivered op seqs, nudging sweeps with pings (the
+        drain sweep fires on inbound socket traffic)."""
+        got = []
+        sock.settimeout(0.2)
+        deadline = time.monotonic() + deadline_s
+        while len(got) < want_n and time.monotonic() < deadline:
+            try:
+                data = sock.recv(65536)
+            except TimeoutError:
+                sock.sendall(wsproto.encode_frame(
+                    wsproto.OP_PING, b"", mask=True
+                ))
+                continue
+            if not data:
+                break
+            for opcode, payload in dec.feed(data):
+                if opcode == wsproto.OP_TEXT:
+                    m = json.loads(payload.decode())
+                    if m.get("type") == "op":
+                        got.append(m["msg"]["sequence_number"])
+                elif opcode == wsproto.OP_BINARY:
+                    sf = SeqFrame.decode(payload)
+                    got.extend(range(sf.first_seq, sf.last_seq + 1))
+        return got
+
+    def _subscribed(self, srv, port, doc):
+        sock, dec, _p = _ws_connect(port)
+        _subscribe_push(sock, doc)
+        sock.settimeout(5)
+        while True:
+            done = False
+            for opcode, payload in dec.feed(sock.recv(65536)):
+                if opcode == wsproto.OP_TEXT:
+                    m = json.loads(payload.decode())
+                    if m.get("type") == "subscribe_push_success":
+                        done = True
+            if done:
+                return sock, dec
+
+    def test_push_writes_run_on_drainer_thread(self):
+        """The offload itself: delivered push bytes were written by the
+        drainer thread, not the loop thread — and delivery is complete
+        and in order."""
+        svc = PipelineFluidService(n_partitions=1, device_backend=False)
+        srv = FluidNetworkServer(svc)
+        srv.start()
+        sock = None
+        try:
+            conn = svc.connect("off")
+            sock, dec = self._subscribed(srv, srv.port, "off")
+            head = svc.doc_head("off")
+            conn.submit_frame(_frame(conn, 4, 1, head))
+            got = self._drive(srv, sock, dec, want_n=4)
+            assert len(got) >= 4 and got == sorted(got), got
+            # The drainer actually wrote: its thread set is non-empty
+            # and disjoint from the socket loop's thread.
+            dr = srv._push_drainer
+            assert dr.batches >= 1
+            assert dr.threads, "no write ran on the drainer"
+            assert srv._thread.ident not in dr.threads
+            # The raw socket was attached (the offload path, not the
+            # inline fallback).
+            sess = [s for s in srv._sessions if s.push_doc == "off"]
+            assert sess and sess[0].push_sock is not None
+        finally:
+            if sock is not None:
+                sock.close()
+            srv.stop()
+
+    def test_offload_fail_requeues_tail_then_delivers(self):
+        """push.fanout FailN through the drainer: the failed
+        subscriber's already-encoded tail requeues (counted) and drains
+        on a later sweep — every op delivered exactly once."""
+        svc = PipelineFluidService(n_partitions=1, device_backend=False)
+        srv = FluidNetworkServer(svc)
+        srv.start()
+        sock = None
+        try:
+            conn = svc.connect("offf")
+            sock, dec = self._subscribed(srv, srv.port, "offf")
+            pre = _retry_total("push.fanout", "requeue")
+            faults.arm("push.fanout", faults.FailN(1))
+            conn.submit_frame(_frame(conn, 3, 1, svc.doc_head("offf")))
+            got = self._drive(srv, sock, dec, want_n=3)
+            faults.disarm()
+            if len(got) < 3:  # the tail drains after disarm at latest
+                got.extend(self._drive(srv, sock, dec, want_n=3 - len(got)))
+            assert len(got) >= 3, got
+            assert got == sorted(set(got)), got  # exactly once, in order
+            assert _retry_total("push.fanout", "requeue") >= pre + 1
+        finally:
+            faults.disarm()
+            if sock is not None:
+                sock.close()
+            srv.stop()
+
+    def test_offload_crash_after_is_exactly_once(self):
+        """push.fanout crash-AFTER through the drainer: the crashed
+        write reached the socket — the watermark advances past it and
+        the client sees NO duplicate (the r11 exactly-once rule, now on
+        the drainer thread)."""
+        svc = PipelineFluidService(n_partitions=1, device_backend=False)
+        srv = FluidNetworkServer(svc)
+        srv.start()
+        sock = None
+        try:
+            conn = svc.connect("offc")
+            sock, dec = self._subscribed(srv, srv.port, "offc")
+            faults.arm("push.fanout", faults.CrashAt("after", times=1))
+            conn.submit_frame(_frame(conn, 3, 1, svc.doc_head("offc")))
+            got = self._drive(srv, sock, dec, want_n=3)
+            faults.disarm()
+            if len(got) < 3:
+                got.extend(self._drive(srv, sock, dec, want_n=3 - len(got)))
+            assert len(got) >= 3, got
+            assert got == sorted(set(got)), got  # no dup, no gap
+        finally:
+            faults.disarm()
+            if sock is not None:
+                sock.close()
+            srv.stop()
+
+    def test_partial_stall_requeues_payload_suffix(self):
+        """A bounded-write stall mid-payload must requeue the UNSENT
+        SUFFIX bytes (same seq), never the whole payload — a full
+        resend after a delivered prefix would tear the subscriber's
+        frame stream. Driven on a real socketpair with a tiny send
+        buffer so the kernel genuinely stalls the write."""
+        svc = PipelineFluidService(n_partitions=1, device_backend=False)
+        srv = FluidNetworkServer(svc)
+        srv.PUSH_WRITE_TIMEOUT_S = 0.05
+        a, b = socket.socketpair()
+        try:
+            a.setblocking(False)
+            a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 8192)
+            s = _Session(_Writer())
+            s.push_doc = "p"
+            s.push_sock = a
+            payload = bytes(range(256)) * 4096  # ~1MB >> SO_SNDBUF
+            srv._push_send_sync(s, [(7, payload, False)])
+            assert s.push_tail, "stalled write kept no tail"
+            assert s.push_seq == 0  # watermark held below the payload
+            seq, rest, _binary = s.push_tail[0]
+            assert seq == 7
+            assert 0 < len(rest) < len(payload), (
+                "tail must be the unsent suffix, not the whole payload"
+            )
+            # Drain the peer while retrying the tail: the bytes that
+            # arrive must reassemble EXACTLY the original payload.
+            got = bytearray()
+            b.setblocking(False)
+            deadline = time.monotonic() + 10
+            while s.push_tail and time.monotonic() < deadline:
+                try:
+                    got += b.recv(1 << 20)
+                except BlockingIOError:
+                    time.sleep(0.005)
+                tail, s.push_tail = s.push_tail, []
+                srv._push_send_sync(s, tail)
+            deadline = time.monotonic() + 5
+            while len(got) < len(payload) and time.monotonic() < deadline:
+                try:
+                    got += b.recv(1 << 20)
+                except BlockingIOError:
+                    time.sleep(0.005)
+            assert bytes(got) == payload, (
+                f"stream reassembled {len(got)} bytes != {len(payload)}"
+            )
+            assert s.push_seq == 7  # watermark advanced once complete
+        finally:
+            a.close()
+            b.close()
+
+    def test_busy_session_never_drags_group_or_double_enqueues(self):
+        """While a batch is in flight on the drainer the sweep skips the
+        session (no concurrent state access, no duplicate batch) and
+        the group read never rewinds to its watermark."""
+        svc = PipelineFluidService(n_partitions=1, device_backend=False)
+        srv = FluidNetworkServer(svc)
+        conn = svc.connect("busy")
+        s = _push_session(server=srv, doc="busy")
+        conn.submit_frame(_frame(conn, 3, 1, svc.doc_head("busy")))
+        s.push_busy = True  # batch in flight on the drainer
+        srv._drain_all()
+        assert _delivered_seqs(s.writer) == []  # untouched while busy
+        s.push_busy = False
+        srv._drain_all()
+        got = _delivered_seqs(s.writer)
+        assert len(got) >= 3 and got == sorted(set(got)), got
+
+
+# ---------------------------------------------------------------------------
 # The server read path: batched REST snapshot reads + SHED_READS
 
 

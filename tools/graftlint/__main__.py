@@ -2,7 +2,9 @@
 
 Modes:
   (default / --check)    run all passes, subtract the committed baseline,
-                         exit 1 on any finding (the CI gate)
+                         exit 1 on any finding (the CI gate) — including
+                         stale-pragma findings: a reasoned pragma whose
+                         finding no longer fires must be deleted
   --regen-fingerprints   accept intentional codec changes: rewrite
                          api-report/wire_fingerprints.json, bumping the
                          version of every drifted module
@@ -11,6 +13,16 @@ Modes:
                          committed baseline must be empty at merge)
   --passes a,b           restrict to a subset of pass ids
   --no-baseline          report everything, ignoring the baseline
+  --stale-pragmas        report ONLY stale-pragma findings (the sweep
+                         mode — the default --check already fails on
+                         them)
+  --format text|json|sarif
+                         machine-readable findings, so the CI lint job
+                         annotates the PR diff instead of only failing
+                         the build
+  --timings              emit per-pass wall seconds (the CI lint job
+                         runs with this so a slow pass is visible in
+                         the job log, not just as a slower gate)
 """
 
 from __future__ import annotations
@@ -22,6 +34,71 @@ import sys
 
 from tools.graftlint import config, core
 from tools.graftlint.passes import ALL_PASSES, wire_drift
+
+
+def _as_json(findings, stale, timings=None) -> dict:
+    doc = {
+        "tool": "graftlint",
+        "findings": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "col": f.col,
+                "message": f.message,
+            }
+            for f in findings
+        ],
+        "stale_baseline_entries": list(stale),
+    }
+    if timings is not None:
+        doc["pass_seconds"] = {
+            k: round(v, 4) for k, v in sorted(timings.items())
+        }
+    return doc
+
+
+def _as_sarif(findings) -> dict:
+    """SARIF 2.1.0 — the minimal shape GitHub's code-scanning upload and
+    PR annotators consume."""
+    rules = sorted({f.rule for f in findings})
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftlint",
+                        "informationUri": "tools/graftlint/README.md",
+                        "rules": [{"id": r} for r in rules],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "level": "error",
+                        "message": {"text": f.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {"uri": f.path},
+                                    "region": {
+                                        "startLine": f.line,
+                                        "startColumn": f.col,
+                                    },
+                                }
+                            }
+                        ],
+                    }
+                    for f in findings
+                ],
+            }
+        ],
+    }
 
 
 def main(argv=None) -> int:
@@ -39,6 +116,14 @@ def main(argv=None) -> int:
                     help="snapshot current findings into the baseline")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore the committed baseline")
+    ap.add_argument("--stale-pragmas", action="store_true",
+                    help="report only stale-pragma findings (sweep mode)")
+    ap.add_argument("--format", default="text",
+                    choices=("text", "json", "sarif"),
+                    help="findings output format (json/sarif for CI "
+                         "diff annotation)")
+    ap.add_argument("--timings", action="store_true",
+                    help="emit per-pass wall seconds")
     args = ap.parse_args(argv)
 
     root = config.REPO_ROOT
@@ -60,7 +145,8 @@ def main(argv=None) -> int:
 
     if args.write_baseline:
         findings, _ = core.run(root, passes=passes, paths=args.paths or None,
-                               use_baseline=False)
+                               use_baseline=False,
+                               check_stale_pragmas=False)
         path = os.path.join(root, config.BASELINE_FILE)
         with open(path, "w") as f:
             json.dump([fi.baseline_key() for fi in findings], f, indent=1)
@@ -69,12 +155,29 @@ def main(argv=None) -> int:
               "committed baseline must be empty at merge")
         return 0
 
+    timings: dict = {}
     findings, stale = core.run(
         root,
         passes=passes,
         paths=args.paths or None,
         use_baseline=not args.no_baseline,
+        timings=timings if args.timings else None,
     )
+    if args.stale_pragmas:
+        findings = [f for f in findings if f.rule == "stale-pragma"]
+        stale = []
+
+    if args.format == "json":
+        print(json.dumps(
+            _as_json(findings, stale,
+                     timings if args.timings else None),
+            indent=1,
+        ))
+        return 1 if findings or stale else 0
+    if args.format == "sarif":
+        print(json.dumps(_as_sarif(findings), indent=1))
+        return 1 if findings or stale else 0
+
     for f in findings:
         print(f.render())
     for e in stale:
@@ -83,6 +186,9 @@ def main(argv=None) -> int:
             f"{e['rule']!r} ({e['source_line'][:60]!r}) — remove it from "
             f"{config.BASELINE_FILE}"
         )
+    if args.timings:
+        for pid in sorted(timings):
+            print(f"graftlint: pass {pid}: {timings[pid]:.3f}s")
     n = len(findings) + len(stale)
     if n:
         print(f"graftlint: {len(findings)} finding(s), "

@@ -29,13 +29,17 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 from tools.graftlint import config
 
 # rule id -> pragma name that suppresses it (wire-drift has no pragma: the
-# lock file + version bump is its acceptance mechanism).
+# lock file + version bump is its acceptance mechanism; fault-site and
+# vocab-drift likewise accept by vocabulary declaration).
 PRAGMA_OF_RULE = {
     "host-sync": "readback",
     "recompile-hazard": "recompile",
     "determinism": "nondet",
+    "loop-blocking": "onloop",
+    "lock-order": "lockorder",
 }
 KNOWN_PRAGMAS = frozenset(PRAGMA_OF_RULE.values())
+RULE_OF_PRAGMA = {v: k for k, v in PRAGMA_OF_RULE.items()}
 
 
 @dataclass(frozen=True)
@@ -64,6 +68,7 @@ class Pragma:
     name: str
     reason: str
     comment_only: bool  # pragma sits on a comment-only line
+    used: bool = False  # suppressed at least one finding this run
 
 
 @dataclass
@@ -113,7 +118,9 @@ class ModuleSource:
 
     def suppressed(self, finding: Finding, node: ast.AST) -> bool:
         """True when a reasoned pragma of the finding's rule covers the
-        node's statement span."""
+        node's statement span. Marks the matching pragma USED — the
+        stale-pragma check reports reasoned pragmas whose finding no
+        longer fires, so the audited-exception set can only shrink."""
         name = PRAGMA_OF_RULE.get(finding.rule)
         if name is None:
             return False
@@ -122,9 +129,8 @@ class ModuleSource:
         for p in self.pragmas:
             if p.name != name or not p.reason.strip():
                 continue
-            if lo <= p.line <= hi:
-                return True
-            if p.comment_only and p.line == lo - 1:
+            if lo <= p.line <= hi or (p.comment_only and p.line == lo - 1):
+                p.used = True
                 return True
         return False
 
@@ -280,13 +286,29 @@ def run(
     passes: Optional[Iterable[str]] = None,
     paths: Optional[Sequence[str]] = None,
     use_baseline: bool = True,
+    timings: Optional[Dict[str, float]] = None,
+    check_stale_pragmas: bool = True,
 ) -> Tuple[List[Finding], List[dict]]:
     """Run the selected passes over their configured scopes.
 
     Returns (findings, stale_baseline_entries). ``paths`` additionally
     filters every pass's scope to the given repo-relative files (fast
-    pre-commit loops).
+    pre-commit loops). Pass a dict as ``timings`` to collect per-pass
+    wall seconds (the CI lint job emits them).
+
+    Two post-file checks run after the per-file loop:
+
+    - passes exposing ``finalize()`` contribute whole-scope findings
+      (lock-order cycles, dead vocabulary entries) — skipped under a
+      ``paths`` filter, where a partial scan cannot prove anything
+      about the rest of the scope;
+    - the STALE-PRAGMA check: a reasoned pragma whose pass ran over its
+      file without it suppressing anything is itself a finding — the
+      audited-exception set can only shrink, never silently outlive the
+      hazard it excused.
     """
+    import time as _time
+
     from tools.graftlint.passes import ALL_PASSES
 
     root = root or config.REPO_ROOT
@@ -295,9 +317,13 @@ def run(
         for p in ALL_PASSES
         if passes is None or p.id in set(passes)
     ]
+    selected_ids = {p.id for p in selected}
     findings: List[Finding] = []
     seen_files = set()
     src_cache: Dict[str, ModuleSource] = {}
+    # file -> rules whose pass scanned it (the stale check needs to know
+    # a pragma's pass actually looked before calling the pragma dead).
+    scanned_by: Dict[str, set] = {}
 
     def get_src(rel: str) -> Optional[ModuleSource]:
         if rel not in src_cache:
@@ -317,6 +343,7 @@ def run(
         return src_cache[rel]
 
     for p in selected:
+        t0 = _time.perf_counter()
         for rel in p.scope(root):
             if paths and rel not in paths:
                 continue
@@ -326,9 +353,48 @@ def run(
             if rel not in seen_files:
                 seen_files.add(rel)
                 findings.extend(pragma_findings(src))
+            scanned_by.setdefault(rel, set()).add(p.id)
             for f, node in p.run(src):
                 if not src.suppressed(f, node):
                     findings.append(f)
+        fin = getattr(p, "finalize", None)
+        if fin is not None and not paths:
+            findings.extend(fin())
+        if timings is not None:
+            timings[p.id] = (
+                timings.get(p.id, 0.0) + _time.perf_counter() - t0
+            )
+    if check_stale_pragmas:
+        for rel in sorted(seen_files):
+            src = src_cache.get(rel)
+            if src is None:
+                continue
+            for p in src.pragmas:
+                rule = RULE_OF_PRAGMA.get(p.name)
+                if (
+                    rule is None
+                    or not p.reason.strip()
+                    or p.used
+                    or rule not in selected_ids
+                ):
+                    continue
+                if rule not in scanned_by.get(rel, ()):  # pass never looked
+                    continue
+                findings.append(
+                    Finding(
+                        rule="stale-pragma",
+                        path=rel,
+                        line=p.line,
+                        col=1,
+                        message=(
+                            f"stale pragma: `# graftlint: {p.name}(…)` "
+                            f"suppresses nothing — the {rule} finding it "
+                            "excused no longer fires; delete the pragma "
+                            "(the reasoned-exception set only shrinks)"
+                        ),
+                        source_line=src.line_text(p.line),
+                    )
+                )
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     if use_baseline:
         return apply_baseline(findings, load_baseline(root))

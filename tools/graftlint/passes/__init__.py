@@ -1,12 +1,19 @@
 """Pass registry. Each pass exposes ``id``, ``scope(root)`` (the
 repo-relative files it covers), and ``run(src)`` yielding
 ``(Finding, flagged_node)`` pairs — the node carries the statement span
-pragma suppression checks against."""
+pragma suppression checks against. A pass may additionally expose
+``finalize()`` returning plain findings that need the WHOLE scope
+scanned first (cross-file lock-order cycles, dead vocabulary entries);
+the runner calls it after the file loop, and skips it under a paths
+filter (partial scans cannot prove an entry dead)."""
 
 from tools.graftlint.passes.determinism import DeterminismPass
 from tools.graftlint.passes.fault_site import FaultSitePass
 from tools.graftlint.passes.host_sync import HostSyncPass
+from tools.graftlint.passes.lock_order import LockOrderPass
+from tools.graftlint.passes.loop_blocking import LoopBlockingPass
 from tools.graftlint.passes.recompile import RecompileHazardPass
+from tools.graftlint.passes.vocab_drift import VocabDriftPass
 from tools.graftlint.passes.wire_drift import WireDriftPass
 
 ALL_PASSES = (
@@ -15,6 +22,9 @@ ALL_PASSES = (
     DeterminismPass(),
     FaultSitePass(),
     WireDriftPass(),
+    LoopBlockingPass(),
+    LockOrderPass(),
+    VocabDriftPass(),
 )
 
 __all__ = [
@@ -22,6 +32,9 @@ __all__ = [
     "DeterminismPass",
     "FaultSitePass",
     "HostSyncPass",
+    "LockOrderPass",
+    "LoopBlockingPass",
     "RecompileHazardPass",
+    "VocabDriftPass",
     "WireDriftPass",
 ]

@@ -129,11 +129,116 @@ def device_fn_names(tree: ast.AST) -> Set[str]:
     return out
 
 
-class _Taint:
-    """Local device-taint evaluation for one function (or module) body."""
+def _seed_params(taint: "_Taint", fn: ast.AST) -> None:
+    """Device-param contract (``config.DEVICE_PARAM_FNS``): the off-loop
+    transfer halves receive concrete device arrays by design — their
+    parameters START tainted so the np.asarray inside is a verified
+    readback, not an invisible one."""
+    if getattr(fn, "name", None) not in config.DEVICE_PARAM_FNS:
+        return
+    a = fn.args
+    for arg in (
+        list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+    ):
+        if arg.arg not in ("self", "cls"):
+            taint.env[arg.arg] = True
 
-    def __init__(self, device_fns: Set[str]):
+
+def device_method_names(
+    tree: ast.AST, device_fns: Set[str]
+) -> Tuple[Set[str], Set[str]]:
+    """(device-returning names, ALL local function names): same-module
+    functions/methods whose return value is device-tainted, as a fixed
+    point (a method returning another device method's result is itself
+    a device source). The full name set makes the summaries
+    authoritative — a local call NOT in the device set returns host."""
+    fns = [
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    local = {fn.name for fn in fns}
+    methods: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for fn in fns:
+            if fn.name in methods:
+                continue
+            if _returns_tainted(fn, device_fns, methods, local):
+                methods.add(fn.name)
+                changed = True
+    return methods, local
+
+
+def _returns_tainted(
+    fn: ast.AST, device_fns: Set[str], methods: Set[str], local: Set[str]
+) -> bool:
+    taint = _Taint(device_fns, methods, local)
+    _seed_params(taint, fn)
+    found = False
+
+    def walk(body) -> None:
+        nonlocal found
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if (
+                isinstance(stmt, ast.Return)
+                and stmt.value is not None
+                and taint.tainted(stmt.value)
+            ):
+                found = True
+            if isinstance(stmt, ast.Assign):
+                v = taint.tainted(stmt.value)
+                for t in stmt.targets:
+                    taint.bind(t, v)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                taint.bind(stmt.target, taint.tainted(stmt.value))
+            elif isinstance(stmt, ast.AugAssign):
+                if taint.tainted(stmt.value):
+                    taint.bind(stmt.target, True)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                taint.bind(stmt.target, taint.tainted(stmt.iter))
+                walk(stmt.body)
+                walk(stmt.orelse)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                walk(stmt.body)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                walk(stmt.body)
+                walk(stmt.orelse)
+            elif isinstance(stmt, ast.Try):
+                for blk in (stmt.body, stmt.orelse, stmt.finalbody):
+                    walk(blk)
+                for h in stmt.handlers:
+                    walk(h.body)
+
+    walk(fn.body)
+    return found
+
+
+class _Taint:
+    """Local device-taint evaluation for one function (or module) body.
+
+    ``device_methods`` are same-module functions/methods whose RETURN is
+    device-tainted (computed by :func:`device_method_names` as a fixed
+    point) — ``self._telemetry_device()`` is as much a device source as
+    a jitted call, and without the summary the readback pragma on its
+    consumer would be unverifiable."""
+
+    def __init__(
+        self,
+        device_fns: Set[str],
+        device_methods: Set[str] = frozenset(),
+        local_fns: Set[str] = frozenset(),
+    ):
         self.device_fns = device_fns
+        self.device_methods = device_methods
+        # Every same-module function name: where a summary exists it is
+        # AUTHORITATIVE — a local call not in device_methods returns
+        # host, even over tainted args (the generic carries-taint rule
+        # is for constructors/unknown callees only).
+        self.local_fns = local_fns
         self.env: Dict[str, bool] = {}
 
     # -- expression taint ------------------------------------------------------
@@ -164,6 +269,17 @@ class _Taint:
                     return False
             if _is_jnp_call(f):
                 return True
+            # Same-module functions/methods: the computed return-taint
+            # summary decides, in either direction.
+            if isinstance(f, ast.Name) and f.id in self.local_fns:
+                return f.id in self.device_methods
+            if (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("self", "cls")
+                and f.attr in self.local_fns
+            ):
+                return f.attr in self.device_methods
             # Method call on a tainted receiver stays on device
             # (dev.sum(), state._replace(...), tainted[i].max()).
             if isinstance(f, ast.Attribute):
@@ -194,7 +310,7 @@ class _Taint:
         return False
 
     def _comp_scope(self, generators) -> "_Taint":
-        sub = _Taint(self.device_fns)
+        sub = _Taint(self.device_fns, self.device_methods, self.local_fns)
         sub.env = dict(self.env)
         for gen in generators:
             if sub.tainted(gen.iter):
@@ -225,9 +341,15 @@ class HostSyncPass:
 
     def run(self, src: ModuleSource) -> Iterator[Tuple[Finding, ast.AST]]:
         device_fns = device_fn_names(src.tree)
+        device_methods, local_fns = device_method_names(
+            src.tree, device_fns
+        )
         # Module body + every function body, each with a fresh local env.
         yield from self._walk_body(
-            src, src.tree.body, _Taint(device_fns), device_fns
+            src,
+            src.tree.body,
+            _Taint(device_fns, device_methods, local_fns),
+            (device_fns, device_methods, local_fns),
         )
 
     # -- statement walk --------------------------------------------------------
@@ -242,10 +364,11 @@ class HostSyncPass:
         for stmt in body:
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 # Fresh local scope; parameters start untainted (callers
-                # own their transfers).
-                yield from self._walk_body(
-                    src, stmt.body, _Taint(device_fns), device_fns
-                )
+                # own their transfers) — EXCEPT the declared off-loop
+                # transfer halves, whose params are device by contract.
+                sub = _Taint(*device_fns)
+                _seed_params(sub, stmt)
+                yield from self._walk_body(src, stmt.body, sub, device_fns)
                 continue
             if isinstance(stmt, ast.ClassDef):
                 yield from self._walk_body(src, stmt.body, taint, device_fns)

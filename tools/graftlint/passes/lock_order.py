@@ -1,0 +1,442 @@
+"""lock-order: static lock-acquisition discipline for telemetry/service.
+
+The r16 e2e drives caught the repo's nastiest class of bug so far: the
+profiler's gc callback took the metrics/ring locks, and since gc fires
+mid-allocation on WHATEVER thread triggered collection — including one
+already inside a locked ``observe()`` — the event loop deadlocked
+against itself (intermittent /metrics hangs). The fix made the callback
+lock-free **by contract** (it only buffers; ``drain_gc_events`` folds).
+This pass makes that contract — and the wider ordering discipline it is
+an instance of — machine-enforced:
+
+- **Lock graph + cycle detection**: every ``with <lock>`` acquisition
+  (attributes named ``lock``/``_lock``/``*_lock``) is a node; acquiring
+  M while holding L adds the edge L→M — directly, through same-module
+  calls made under the lock, and through the known cross-module lock
+  calls (metric ``inc``/``observe`` take the per-metric lock, registry
+  registration takes the registry lock, ``journal.record``/
+  ``profiler.record`` take their ring locks). Any cycle in the combined
+  graph across the scope is a deadlock waiting for the right interleave
+  — reported once per cycle, with the edge list.
+- **Lock-free contexts**: functions registered in ``gc.callbacks`` or
+  as ``signal.signal`` handlers must acquire NO lock, transitively —
+  the exact r16 shape. The acceptance mechanism for a lock-needing
+  collector hook is the buffer-and-drain split, not a pragma.
+- **Render paths** (``config.RENDER_PATHS`` — the exposition functions
+  scrape threads call): may take ONE lock at a time (the snapshot-
+  under-lock-render-outside pattern); acquiring a second lock while
+  holding one is the nested-hold shape that turns a scrape into a
+  deadlock participant.
+
+Cycle findings have NO pragma — like wire-drift, the acceptance
+mechanism is structural (order the locks, or split the hold). The
+per-file findings (nested render hold, forbidden-context acquisition)
+accept a reasoned ``# graftlint: lockorder(<reason>)`` for audited
+exceptions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.graftlint import config
+from tools.graftlint.core import Finding, ModuleSource, scope_files
+
+
+def _lock_attr_name(node: ast.AST) -> Optional[str]:
+    """The lock attribute name when ``node`` is a recognized lock
+    expression (``self._lock``, ``hist._lock``, ``LOCK``...)."""
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    else:
+        return None
+    low = name.lower()
+    if low in config.LOCK_NAMES or low.endswith("_lock"):
+        return name
+    return None
+
+
+class _FnLocks:
+    """Per-function lock facts: direct acquisitions (with the held set
+    at that point), same-module calls made while holding, and
+    cross-module known-lock calls."""
+
+    __slots__ = ("name", "node", "acquires", "calls", "closure")
+
+    def __init__(self, name: str, node: ast.AST):
+        self.name = name
+        self.node = node
+        # (lock id, node, anchor stmt, held tuple at acquisition)
+        self.acquires: List[Tuple[str, ast.AST, ast.stmt, Tuple[str, ...]]] = []
+        # (callee bare name, node, anchor stmt, held tuple)
+        self.calls: List[Tuple[str, ast.AST, ast.stmt, Tuple[str, ...]]] = []
+        self.closure: Set[str] = set()  # locks this fn may acquire
+
+
+class LockOrderPass:
+    id = "lock-order"
+
+    def __init__(self) -> None:
+        # Cross-file state for the cycle check (finalize).
+        self._edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        self._edge_order: List[Tuple[str, str]] = []
+
+    def scope(self, root: str) -> List[str]:
+        self._edges = {}
+        self._edge_order = []
+        return scope_files(root, config.LOCK_SCOPE)
+
+    # -- lock identity ---------------------------------------------------------
+
+    def _lock_id(
+        self, node: ast.AST, cls: Optional[str], src: ModuleSource
+    ) -> Optional[str]:
+        attr = _lock_attr_name(node)
+        if attr is None:
+            return None
+        if isinstance(node, ast.Attribute):
+            recv = node.value
+            if isinstance(recv, ast.Name) and recv.id in ("self", "cls"):
+                owner = cls or src.path
+            else:
+                owner = ast.unparse(recv)
+        else:
+            owner = src.path
+        return f"{owner}.{attr}"
+
+    # -- per-function walk -----------------------------------------------------
+
+    def _collect(
+        self, src: ModuleSource
+    ) -> Tuple[Dict[str, _FnLocks], List[str], List[str]]:
+        """(functions, gc-callback names, signal-handler names)."""
+        fns: Dict[str, _FnLocks] = {}
+        gc_cbs: List[str] = []
+        sig_handlers: List[str] = []
+
+        def visit_fn(fn: ast.AST, cls: Optional[str]) -> None:
+            info = fns.setdefault(fn.name, _FnLocks(fn.name, fn))
+            self._walk_body(src, fn.body, cls, (), info)
+
+        def visit_scope(body, cls: Optional[str]) -> None:
+            for stmt in body:
+                if isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    visit_fn(stmt, cls)
+                    visit_scope(stmt.body, cls)
+                elif isinstance(stmt, ast.ClassDef):
+                    visit_scope(stmt.body, stmt.name)
+
+        visit_scope(src.tree.body, None)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            # gc.callbacks.append(fn)
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "append"
+                and isinstance(f.value, ast.Attribute)
+                and f.value.attr == "callbacks"
+                and isinstance(f.value.value, ast.Name)
+                and f.value.value.id == "gc"
+                and node.args
+            ):
+                name = _term(node.args[0])
+                if name:
+                    gc_cbs.append(name)
+            # signal.signal(sig, fn)
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "signal"
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "signal"
+                and len(node.args) == 2
+            ):
+                name = _term(node.args[1])
+                if name:
+                    sig_handlers.append(name)
+        return fns, gc_cbs, sig_handlers
+
+    def _walk_body(
+        self,
+        src: ModuleSource,
+        body: Sequence[ast.stmt],
+        cls: Optional[str],
+        held: Tuple[str, ...],
+        info: _FnLocks,
+    ) -> None:
+        for stmt in body:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue  # visited as their own functions/scopes
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                now = held
+                for item in stmt.items:
+                    lock = self._lock_id(item.context_expr, cls, src)
+                    if lock is not None:
+                        info.acquires.append((lock, item.context_expr, stmt, now))
+                        now = now + (lock,)
+                    else:
+                        self._scan_expr(
+                            src, item.context_expr, stmt, cls, now, info
+                        )
+                self._walk_body(src, stmt.body, cls, now, info)
+                continue
+            # Scan this statement's own expressions, then recurse into
+            # compound-statement bodies with the same held set.
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan_expr(src, stmt.iter, stmt, cls, held, info)
+                self._walk_body(src, stmt.body, cls, held, info)
+                self._walk_body(src, stmt.orelse, cls, held, info)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                self._scan_expr(src, stmt.test, stmt, cls, held, info)
+                self._walk_body(src, stmt.body, cls, held, info)
+                self._walk_body(src, stmt.orelse, cls, held, info)
+            elif isinstance(stmt, ast.Try):
+                for blk in (stmt.body, stmt.orelse, stmt.finalbody):
+                    self._walk_body(src, blk, cls, held, info)
+                for h in stmt.handlers:
+                    self._walk_body(src, h.body, cls, held, info)
+            else:
+                self._scan_expr(src, stmt, stmt, cls, held, info)
+
+    def _scan_expr(
+        self,
+        src: ModuleSource,
+        root: ast.AST,
+        stmt: ast.stmt,
+        cls: Optional[str],
+        held: Tuple[str, ...],
+        info: _FnLocks,
+    ) -> None:
+        for node in ast.walk(root):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = _term(f)
+            # Explicit .acquire() on a lock.
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "acquire"
+            ):
+                lock = self._lock_id(f.value, cls, src)
+                if lock is not None:
+                    info.acquires.append((lock, node, stmt, held))
+                    continue
+            # Known cross-module lock calls.
+            known = self._known_lock(node)
+            if known is not None:
+                info.acquires.append((known, node, stmt, held))
+                continue
+            if isinstance(f, (ast.Name, ast.Attribute)) and name:
+                info.calls.append((name, node, stmt, held))
+
+    @staticmethod
+    def _known_lock(node: ast.Call) -> Optional[str]:
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        if f.attr == "record":
+            recv = _term(f.value)
+            if recv in config.RECORD_LOCKS:
+                return config.RECORD_LOCKS[recv]
+            return None
+        if f.attr in config.KNOWN_LOCK_CALLS:
+            recv = _term(f.value)
+            if f.attr in ("inc", "observe"):
+                return config.KNOWN_LOCK_CALLS[f.attr]
+            # counter/gauge/histogram registration: only on registry
+            # receivers (reg/registry/REGISTRY).
+            if recv.lower() in ("reg", "registry"):
+                return config.KNOWN_LOCK_CALLS[f.attr]
+        return None
+
+    # -- pass entry ------------------------------------------------------------
+
+    def run(self, src: ModuleSource) -> Iterator[Tuple[Finding, ast.AST]]:
+        fns, gc_cbs, sig_handlers = self._collect(src)
+
+        # Per-function acquire closures (fixed point over local calls).
+        for info in fns.values():
+            info.closure = {lock for lock, *_ in info.acquires}
+        changed = True
+        while changed:
+            changed = False
+            for info in fns.values():
+                for callee, *_ in info.calls:
+                    sub = fns.get(callee)
+                    if sub is None:
+                        continue
+                    if not sub.closure <= info.closure:
+                        info.closure |= sub.closure
+                        changed = True
+
+        # Lock-order edges: held L × acquired M (direct and via calls).
+        for info in fns.values():
+            for lock, node, stmt, held in info.acquires:
+                for h in held:
+                    if h != lock:
+                        self._add_edge(h, lock, src, node)
+            for callee, node, stmt, held in info.calls:
+                if not held:
+                    continue
+                sub = fns.get(callee)
+                if sub is None:
+                    continue
+                for h in held:
+                    for lock in sub.closure:
+                        if h != lock:
+                            self._add_edge(h, lock, src, node)
+
+        # Self-deadlock: re-acquiring a non-reentrant lock already held.
+        for info in fns.values():
+            for lock, node, stmt, held in info.acquires:
+                if lock in held:
+                    yield (
+                        src.finding(
+                            self.id,
+                            node,
+                            f"lock {lock!r} acquired while already held "
+                            "— a non-reentrant self-deadlock",
+                        ),
+                        stmt,
+                    )
+
+        # Lock-free contexts: gc callbacks and signal handlers.
+        for kind, names in (
+            ("gc.callbacks", gc_cbs),
+            ("signal handler", sig_handlers),
+        ):
+            for name in names:
+                info = fns.get(name)
+                if info is None:
+                    continue
+                locks = sorted(info.closure)
+                if locks:
+                    yield (
+                        src.finding(
+                            self.id,
+                            info.node,
+                            f"{kind} {name!r} may acquire "
+                            f"{', '.join(locks)} — {kind.split()[0]} "
+                            "contexts run mid-allocation on arbitrary "
+                            "threads and must be lock-free by contract "
+                            "(buffer and drain instead; "
+                            "docs/failure-semantics.md, the r16 "
+                            "gc-callback deadlock)",
+                        ),
+                        info.node,
+                    )
+
+        # Render paths: one lock at a time (snapshot under the lock,
+        # render outside it).
+        for name in config.RENDER_PATHS.get(src.path, ()):
+            info = fns.get(name)
+            if info is None:
+                continue
+            for lock, node, stmt, held in info.acquires:
+                if held and lock not in held:
+                    yield (
+                        src.finding(
+                            self.id,
+                            node,
+                            f"render path {name}() acquires {lock!r} "
+                            f"while holding {held[-1]!r} — render paths "
+                            "hold ONE lock at a time (snapshot under "
+                            "the lock, render outside it)",
+                        ),
+                        stmt,
+                    )
+            for callee, node, stmt, held in info.calls:
+                sub = fns.get(callee)
+                if sub is None or not held:
+                    continue
+                nested = sorted(sub.closure - set(held))
+                if nested:
+                    yield (
+                        src.finding(
+                            self.id,
+                            node,
+                            f"render path {name}() calls {callee}() "
+                            f"while holding {held[-1]!r} (it may acquire "
+                            f"{', '.join(nested)}) — render paths hold "
+                            "ONE lock at a time",
+                        ),
+                        stmt,
+                    )
+
+    def _add_edge(
+        self, a: str, b: str, src: ModuleSource, node: ast.AST
+    ) -> None:
+        key = (a, b)
+        if key not in self._edges:
+            self._edges[key] = (src.path, getattr(node, "lineno", 1))
+            self._edge_order.append(key)
+
+    # -- cross-file cycle check ------------------------------------------------
+
+    def finalize(self) -> List[Finding]:
+        """Cycle detection over the aggregated lock graph — runs after
+        every scoped file has contributed its edges."""
+        adj: Dict[str, List[str]] = {}
+        for a, b in self._edge_order:
+            adj.setdefault(a, []).append(b)
+        out: List[Finding] = []
+        seen_cycles: Set[Tuple[str, ...]] = set()
+        state: Dict[str, int] = {}  # 0 visiting / 1 done
+
+        def dfs(nod: str, stack: List[str]) -> None:
+            state[nod] = 0
+            stack.append(nod)
+            for nxt in adj.get(nod, ()):
+                if state.get(nxt) == 0:
+                    cyc = tuple(stack[stack.index(nxt):]) + (nxt,)
+                    # Canonical rotation so each cycle reports once.
+                    body = cyc[:-1]
+                    k = min(range(len(body)), key=lambda i: body[i])
+                    canon = body[k:] + body[:k]
+                    if canon not in seen_cycles:
+                        seen_cycles.add(canon)
+                        path, line = self._edges[(nod, nxt)]
+                        out.append(
+                            Finding(
+                                rule=self.id,
+                                path=path,
+                                line=line,
+                                col=1,
+                                message=(
+                                    "lock-order cycle: "
+                                    + " -> ".join(canon + (canon[0],))
+                                    + " — two threads taking these in "
+                                    "opposite order deadlock; impose "
+                                    "one order or split the hold"
+                                ),
+                            )
+                        )
+                elif state.get(nxt) is None:
+                    dfs(nxt, stack)
+            stack.pop()
+            state[nod] = 1
+
+        for nod in list(adj):
+            if nod not in state:
+                dfs(nod, [])
+        return out
+
+
+def _term(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""

@@ -1,0 +1,540 @@
+"""loop-blocking: blocking calls reachable from the asyncio serving loop.
+
+The serving tier's worst regressions are no longer wire or kernel bugs —
+they are blocking calls that land on the socket event loop: a synchronous
+device readback added for a quick stat stalls every connected client for
+a device RTT (the hazard that forced the r12 ``scan_transfer``/
+``scan_prefetched`` split and the r15 ``read_transfer`` split), a
+``time.sleep`` in a ticker freezes delivery, an unbounded lock acquire
+deadlocks the loop against a producer thread. The r16 loop-stall
+watchdog catches these DYNAMICALLY (``event_loop_lag_ms`` +
+``loop.stall``); this pass is the static half — the regression never
+ships instead of paging someone.
+
+Analysis (per module, single forward pass):
+
+- **On-loop roots**: every ``async def`` (coroutines run on the loop),
+  functions scheduled onto the loop (``loop.call_soon``/``call_later``/
+  ``call_soon_threadsafe``/``add_reader``/``add_writer`` arguments), and
+  the configured cross-module entry points (``config.LOOP_ENTRY`` — the
+  pipeline pump sweep, the device backend's feed/flush/read surface, and
+  the lambda handlers all run inside network_server's loop).
+- **Local call graph**: a call to a same-module function propagates
+  on-loop reachability (bare names and method calls by name). Calls
+  appearing as ``run_in_executor``/``Thread(target=…)``/
+  ``executor.submit`` arguments are SINKS: the callee runs off-loop.
+- **Blocking catalog** inside reachable functions: device→host
+  transfers over device-tainted values (``np.asarray``/``np.array``/
+  ``.tolist()``/``int()``/``float()``/``bool()`` — the host-sync taint
+  machinery, same ``DEVICE_ATTRS``/jit/kernel-import entry rules),
+  ``.item()``/``block_until_ready``/``jax.device_get`` always,
+  ``time.sleep``, sync file IO (``open``, ``Path.read_text`` family),
+  ``subprocess`` calls, sync socket ops, and unbounded
+  ``Lock.acquire()``. A DIRECT call to a declared off-loop helper
+  (``config.OFF_LOOP_HELPERS``) is also flagged — the split exists so
+  the blocking half only ever runs via ``run_in_executor``.
+
+Audited exceptions carry ``# graftlint: onloop(<reason>)`` — e.g. the
+quiescence-path scan barrier, which runs on the loop by DESIGN only once
+ingest has gone quiet.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.graftlint import config
+from tools.graftlint.core import Finding, ModuleSource, scope_files
+from tools.graftlint.passes.host_sync import (
+    _is_np,
+    _seed_params,
+    _Taint,
+    device_fn_names,
+    device_method_names,
+)
+
+#: Call shapes that move their callable argument OFF the loop: the
+#: callee must not be treated as on-loop reachable.
+_SINK_ATTRS = frozenset({"run_in_executor"})
+_SINK_NAMES = frozenset({"Thread", "Timer"})
+
+#: Call shapes that schedule their callable argument ONTO the loop.
+_SCHEDULE_ATTRS = frozenset(
+    {
+        "call_soon",
+        "call_later",
+        "call_at",
+        "call_soon_threadsafe",
+        "add_reader",
+        "add_writer",
+        "add_done_callback",
+    }
+)
+
+_SYNC_FILE_ATTRS = frozenset(
+    {"read_text", "write_text", "read_bytes", "write_bytes"}
+)
+_SYNC_SOCKET_ATTRS = frozenset(
+    {"recv", "recv_into", "accept", "send", "sendall", "connect",
+     "makefile"}
+)
+_SUBPROCESS_ATTRS = frozenset(
+    {"run", "call", "check_call", "check_output", "Popen"}
+)
+
+
+def _terminal_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _is_lockish(node: ast.AST) -> bool:
+    name = _terminal_name(node)
+    return name in config.LOCK_NAMES or name.endswith("_lock")
+
+
+class _FnInfo:
+    __slots__ = ("name", "node", "is_async", "calls", "scheduled")
+
+    def __init__(self, name: str, node: ast.AST, is_async: bool):
+        self.name = name
+        self.node = node
+        self.is_async = is_async
+        self.calls: List[Tuple[str, ast.AST]] = []  # callee name, call node
+
+
+class LoopBlockingPass:
+    id = "loop-blocking"
+
+    def scope(self, root: str) -> List[str]:
+        return scope_files(root, config.LOOP_SCOPE)
+
+    # -- module structure ------------------------------------------------------
+
+    def _collect_fns(self, tree: ast.AST) -> Dict[str, _FnInfo]:
+        """Every function/method in the module, keyed by bare name (a
+        name collision unions the call edges — conservative: both
+        versions inherit reachability)."""
+        fns: Dict[str, _FnInfo] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = _FnInfo(
+                    node.name, node, isinstance(node, ast.AsyncFunctionDef)
+                )
+                prev = fns.get(node.name)
+                if prev is not None:
+                    info.is_async = info.is_async or prev.is_async
+                    info.calls = prev.calls
+                fns[node.name] = info
+        return fns
+
+    def _own_statements(self, fn: ast.AST) -> Iterator[ast.AST]:
+        """Walk a function's body EXCLUDING nested function/lambda
+        bodies (those are separate call-graph entries)."""
+        stack: List[ast.AST] = list(fn.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _is_sink_call(self, call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr in _SINK_ATTRS:
+            return True
+        if isinstance(f, ast.Name) and f.id in _SINK_NAMES:
+            return True
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in _SINK_NAMES
+            and _terminal_name(f.value) == "threading"
+        ):
+            return True
+        return False
+
+    def _edges_and_roots(
+        self, fns: Dict[str, _FnInfo]
+    ) -> Tuple[Dict[str, _FnInfo], Set[str]]:
+        """Populate per-function call edges; return loop-scheduled
+        roots. Calls nested inside a sink call's arguments make no
+        edge — the callable runs off-loop."""
+        scheduled: Set[str] = set()
+        for info in fns.values():
+            sink_spans: List[Tuple[int, int, int, int]] = []
+            for node in self._own_statements(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if self._is_sink_call(node):
+                    sink_spans.append(
+                        (
+                            node.lineno,
+                            node.col_offset,
+                            node.end_lineno or node.lineno,
+                            node.end_col_offset or 0,
+                        )
+                    )
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute) and (
+                    f.attr in _SCHEDULE_ATTRS
+                ):
+                    for arg in node.args:
+                        name = _terminal_name(arg)
+                        if name in fns:
+                            scheduled.add(name)
+                    continue
+                callee = _terminal_name(f)
+                if callee not in fns:
+                    continue
+                # Attribute calls on receivers other than self/cls only
+                # edge for PRIVATE names: a public method name shared
+                # with a builtin ("".join, q.get, t.start) must not
+                # stitch unrelated code into the on-loop graph.
+                if (
+                    isinstance(f, ast.Attribute)
+                    and not (
+                        isinstance(f.value, ast.Name)
+                        and f.value.id in ("self", "cls")
+                    )
+                    and not callee.startswith("_")
+                ):
+                    continue
+                info.calls.append((callee, node))
+            if sink_spans:
+                info.calls = [
+                    (c, n)
+                    for c, n in info.calls
+                    if not any(
+                        (lo, lc) <= (n.lineno, n.col_offset)
+                        and (
+                            n.end_lineno or n.lineno,
+                            n.end_col_offset or 0,
+                        ) <= (hi, hc)
+                        for lo, lc, hi, hc in sink_spans
+                    )
+                ]
+        return fns, scheduled
+
+    def _reachable(
+        self, src: ModuleSource, fns: Dict[str, _FnInfo], scheduled: Set[str]
+    ) -> Dict[str, List[str]]:
+        """On-loop reachable function names -> the root→…→fn path that
+        proves it (for the finding message)."""
+        entry = config.LOOP_ENTRY.get(src.path, ())
+        roots = [
+            name
+            for name, info in fns.items()
+            if info.is_async or name in scheduled or name in entry
+        ]
+        paths: Dict[str, List[str]] = {}
+        queue: List[str] = []
+        for r in sorted(roots):
+            if r in config.OFF_LOOP_HELPERS:
+                continue
+            paths[r] = [r]
+            queue.append(r)
+        while queue:
+            cur = queue.pop(0)
+            for callee, _node in fns[cur].calls:
+                if callee in paths or callee in config.OFF_LOOP_HELPERS:
+                    continue
+                paths[callee] = paths[cur] + [callee]
+                queue.append(callee)
+        return paths
+
+    # -- blocking catalog ------------------------------------------------------
+
+    def _blocking_ops(
+        self,
+        src: ModuleSource,
+        fn: ast.AST,
+        device_fns: Set[str],
+        device_methods: Set[str],
+        local_fns: Set[str],
+    ) -> Iterator[Tuple[ast.AST, ast.stmt, str]]:
+        """(node, anchor statement, message) for every blocking op in
+        one function body — statement-ordered walk so the host-sync
+        taint env is correct at each use."""
+        taint = _Taint(device_fns, device_methods, local_fns)
+        _seed_params(taint, fn)
+        yield from self._walk(src, fn.body, taint)
+
+    def _walk(
+        self, src: ModuleSource, body: Sequence[ast.stmt], taint: _Taint
+    ) -> Iterator[Tuple[ast.AST, ast.stmt, str]]:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # separate call-graph entries
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                roots: List[ast.AST] = [stmt.iter]
+            elif isinstance(stmt, (ast.If, ast.While)):
+                roots = [stmt.test]
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                roots = [i.context_expr for i in stmt.items]
+            elif isinstance(stmt, ast.Try):
+                roots = []
+            else:
+                roots = [stmt]
+            for root in roots:
+                yield from self._check_expr(src, root, stmt, taint)
+            if isinstance(stmt, ast.Assign):
+                v = taint.tainted(stmt.value)
+                for t in stmt.targets:
+                    taint.bind(t, v)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                taint.bind(stmt.target, taint.tainted(stmt.value))
+            elif isinstance(stmt, ast.AugAssign):
+                if taint.tainted(stmt.value):
+                    taint.bind(stmt.target, True)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                taint.bind(stmt.target, taint.tainted(stmt.iter))
+                yield from self._walk(src, stmt.body, taint)
+                yield from self._walk(src, stmt.orelse, taint)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                yield from self._walk(src, stmt.body, taint)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                yield from self._walk(src, stmt.body, taint)
+                yield from self._walk(src, stmt.orelse, taint)
+            elif isinstance(stmt, ast.Try):
+                for blk in (stmt.body, stmt.orelse, stmt.finalbody):
+                    yield from self._walk(src, blk, taint)
+                for h in stmt.handlers:
+                    yield from self._walk(src, h.body, taint)
+
+    def _check_expr(
+        self,
+        src: ModuleSource,
+        root: ast.AST,
+        stmt: ast.stmt,
+        taint: _Taint,
+    ) -> Iterator[Tuple[ast.AST, ast.stmt, str]]:
+        for node in ast.walk(root):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, ast.Attribute):
+                if node.attr == "block_until_ready":
+                    yield (
+                        node,
+                        stmt,
+                        "block_until_ready is a device sync barrier on "
+                        "the event loop",
+                    )
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            if self._is_sink_call(node):
+                continue  # args run off-loop (their OWN defs walk alone)
+            f = node.func
+            fname = _terminal_name(f)
+            # Direct call to a declared off-loop half.
+            if fname in config.OFF_LOOP_HELPERS:
+                yield (
+                    node,
+                    stmt,
+                    f"off-loop helper {fname}() called synchronously on "
+                    "the event loop — route it through run_in_executor "
+                    "(the scan_transfer/read_transfer split)",
+                )
+                continue
+            # time.sleep (asyncio.sleep is awaited and fine).
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "sleep"
+                and _terminal_name(f.value) == "time"
+            ):
+                yield (
+                    node,
+                    stmt,
+                    "time.sleep blocks the event loop — use "
+                    "await asyncio.sleep",
+                )
+                continue
+            # Sync file IO.
+            if isinstance(f, ast.Name) and f.id == "open":
+                yield (
+                    node,
+                    stmt,
+                    "sync file open() on the event loop — move the IO "
+                    "to run_in_executor",
+                )
+                continue
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _SYNC_FILE_ATTRS
+            ):
+                yield (
+                    node,
+                    stmt,
+                    f".{f.attr}() is sync file IO on the event loop — "
+                    "move it to run_in_executor",
+                )
+                continue
+            # Subprocess.
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _SUBPROCESS_ATTRS
+                and _terminal_name(f.value) == "subprocess"
+            ):
+                yield (
+                    node,
+                    stmt,
+                    f"subprocess.{f.attr} blocks the event loop",
+                )
+                continue
+            # Sync socket ops (module-level connects and the classic
+            # recv/accept/sendall shapes on an explicit socket).
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "create_connection"
+                and _terminal_name(f.value) == "socket"
+            ):
+                yield (
+                    node,
+                    stmt,
+                    "socket.create_connection is a sync connect on the "
+                    "event loop",
+                )
+                continue
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _SYNC_SOCKET_ATTRS
+                and "sock" in _terminal_name(f.value).lower()
+            ):
+                yield (
+                    node,
+                    stmt,
+                    f"sync socket .{f.attr}() on the event loop — use "
+                    "the asyncio stream/transport API",
+                )
+                continue
+            # Bounded waits built on select() block the loop for their
+            # full timeout.
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("select", "poll")
+                and _terminal_name(f.value) == "select"
+            ):
+                yield (
+                    node,
+                    stmt,
+                    "select.select blocks the event loop for its "
+                    "timeout — use the loop's own readiness machinery",
+                )
+                continue
+            # Unbounded lock acquire (with-statement holds are fine —
+            # the lock-order pass audits those; a bare .acquire() with
+            # no timeout can park the loop behind any producer thread).
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "acquire"
+                and _is_lockish(f.value)
+                and not node.args
+                and not any(
+                    kw.arg in ("timeout", "blocking")
+                    for kw in node.keywords
+                )
+            ):
+                yield (
+                    node,
+                    stmt,
+                    "unbounded Lock.acquire() on the event loop — pass "
+                    "a timeout or restructure around the loop",
+                )
+                continue
+            # Device→host transfers (the host-sync taint rules).
+            if isinstance(f, ast.Attribute) and f.attr == "item":
+                yield (
+                    node,
+                    stmt,
+                    ".item() is a blocking per-scalar device readback "
+                    "on the event loop",
+                )
+                continue
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "device_get"
+                and _terminal_name(f.value) == "jax"
+            ):
+                yield (
+                    node,
+                    stmt,
+                    "jax.device_get is a blocking device→host transfer "
+                    "on the event loop",
+                )
+                continue
+            if isinstance(f, ast.Attribute) and f.attr == "tolist":
+                if taint.tainted(f.value):
+                    yield (
+                        node,
+                        stmt,
+                        ".tolist() on a device value is a blocking "
+                        "device→host transfer on the event loop",
+                    )
+                continue
+            if _is_np(f, ("asarray", "array")):
+                if node.args and taint.tainted(node.args[0]):
+                    yield (
+                        node,
+                        stmt,
+                        f"np.{f.attr}({ast.unparse(node.args[0])}) is a "
+                        "blocking device→host transfer on the event "
+                        "loop — run it in the executor (the "
+                        "scan_transfer/read_transfer split)",
+                    )
+                continue
+            if (
+                isinstance(f, ast.Name)
+                and f.id in ("int", "float", "bool")
+                and len(node.args) == 1
+                and taint.tainted(node.args[0])
+            ):
+                yield (
+                    node,
+                    stmt,
+                    f"{f.id}({ast.unparse(node.args[0])}) scalarizes a "
+                    "device value on the event loop (one blocking "
+                    "transfer per call)",
+                )
+
+    # -- pass entry ------------------------------------------------------------
+
+    def run(self, src: ModuleSource) -> Iterator[Tuple[Finding, ast.AST]]:
+        fns = self._collect_fns(src.tree)
+        fns, scheduled = self._edges_and_roots(fns)
+        paths = self._reachable(src, fns, scheduled)
+        device_fns = device_fn_names(src.tree)
+        device_methods, local_fns = device_method_names(
+            src.tree, device_fns
+        )
+        for name in sorted(paths):
+            info = fns[name]
+            chain = paths[name]
+            via = (
+                " (on-loop via " + " -> ".join(chain) + ")"
+                if len(chain) > 1
+                else ""
+            )
+            for node, stmt, msg in self._blocking_ops(
+                src, info.node, device_fns, device_methods, local_fns
+            ):
+                yield (
+                    src.finding(
+                        self.id,
+                        node,
+                        msg
+                        + via
+                        + " — annotate `# graftlint: onloop(<reason>)` "
+                        "if this on-loop block is audited and "
+                        "intentional",
+                    ),
+                    stmt,
+                )
