@@ -1389,6 +1389,146 @@ def overload_benchmark(on_tpu: bool) -> dict:
     return rec
 
 
+def residency_benchmark(on_tpu: bool) -> dict:
+    """The r19 exit instrument: fleet-as-cache over a million-document
+    corpus. Document ids draw Zipf-distributed from a 1M-id space onto a
+    fleet whose resident budget is orders of magnitude smaller, so the
+    residency manager must churn — idle docs hibernate to the durable
+    tier (summary pointer + cold record, slot released), and the first
+    op to a COLD doc wakes it through the parked-op pending queue.
+
+    Two lanes run the IDENTICAL op stream: the residency lane under the
+    slot budget (hibernation sweep every round), and a never-evicted
+    reference lane. Before any number is reported the lanes are compared
+    doc-for-doc — every touched document's device state record and
+    served text must match exactly, every document's applied run must be
+    gapless 1..sent (an insert-per-op stream: served length == ops
+    sent), and the residency lane must end with zero parked rows and
+    zero errored docs. Headlines: ``residency_wake_p99_ms`` (first
+    parked op → slot restored, the client-experienced cold-op latency)
+    and ``residency_hit_ratio`` (fraction of ops that found their doc
+    fleet-resident).
+    """
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.protocol.constants import (
+        F_ARG, F_LEN, F_REF, F_SEQ, F_TYPE, OP_INSERT, OP_WIDTH,
+    )
+    from fluidframework_tpu.protocol.opframe import SeqFrame
+    from fluidframework_tpu.service.device_backend import DeviceFleetBackend
+
+    corpus = 1_000_000
+    slots, rounds, fpr, k, hib_per_round = (
+        (10_000, 24, 4096, 8, 2048) if on_tpu else (48, 48, 16, 4, 16)
+    )
+    rng = np.random.default_rng(19)
+    draws = [rng.zipf(1.2, size=fpr) for _ in range(rounds)]
+
+    def frame(sent: int) -> tuple:
+        ar = np.arange(k, dtype=np.int32)
+        rows = np.zeros((k, OP_WIDTH), np.int32)
+        rows[:, F_TYPE] = OP_INSERT
+        rows[:, F_LEN] = 1
+        rows[:, F_SEQ] = sent + 1 + ar
+        rows[:, F_REF] = sent
+        rows[:, F_ARG] = sent + 1 + ar
+        texts = tuple(chr(97 + (sent + i) % 26) for i in range(k))
+        return rows, texts
+
+    def run(evict: bool) -> tuple:
+        be = DeviceFleetBackend(
+            capacity=128, max_batch=1 << 20, pump_mode=True,
+            ring_depth=1, max_resident=slots if evict else 0,
+        )
+        rm = be.residency
+        # Warm the enqueue/flush AND hibernate/wake JIT paths before the
+        # clock starts (the first cold wake otherwise pays _write_slot
+        # compilation, not restore cost).
+        for d in ("warm0", "warm1"):
+            r, t = frame(0)
+            be.enqueue_frame(d, SeqFrame("s", 0, 1, r, t, 0.0))
+        be.flush()
+        assert be.hibernate_doc("warm0")
+        r, t = frame(k)
+        be.enqueue_frame("warm0", SeqFrame("s", 0, 1, r, t, 0.0))
+        be.flush()
+        be.collect_now()
+        rm.wake_ms.clear()
+        rm.hits = rm.misses = 0
+        sent: dict = {}
+        t0 = time.perf_counter()
+        for rnd in range(rounds):
+            drawn = set()
+            for rank in draws[rnd]:
+                d = f"z{(int(rank) - 1) % corpus}"
+                if d in drawn:
+                    continue  # one frame per doc per round
+                drawn.add(d)
+                s = sent.get(d, 0)
+                r, t = frame(s)
+                be.enqueue_frame(d, SeqFrame("s", 0, 1, r, t, 0.0))
+                sent[d] = s + k
+            be.flush()
+            rm.heat.observe_window()
+            if evict:
+                # Clients departed: every resident doc not drawn this
+                # round goes idle (the deli NoClient signal the pipeline
+                # sweep consumes), and the sweep takes the coldest.
+                for d in list(rm.resident_docs()):
+                    if d not in drawn and not d.startswith("warm"):
+                        rm.mark_idle(d)
+                for d in rm.hibernation_candidates(want=hib_per_round):
+                    if be.hibernate_eligible(d):
+                        be.hibernate_doc(d)
+        be.collect_now()
+        elapsed = time.perf_counter() - t0
+        st = be.stats()
+        assert st["parked_rows"] == 0, st
+        assert st["docs_with_errors"] == 0, st
+        return be, sent, elapsed
+
+    be_r, sent, el_r = run(evict=True)
+    be_n, sent_n, _el_n = run(evict=False)
+    assert sent == sent_n  # identical stream by construction
+    if not on_tpu:
+        # The point of the instrument: the touched corpus alone must
+        # exceed the slot budget, or nothing ever churns.
+        assert len(sent) > slots, (len(sent), slots)
+    # Zero lost / zero dup, and residency-vs-never-evicted parity: every
+    # touched doc's applied run is gapless 1..sent (insert-per-op ⇒
+    # served length == ops sent) and its device state record matches the
+    # never-evicted lane field for field.
+    keys = [(d, "s") for d in sent]
+    st_r = be_r.doc_states(keys)
+    st_n = be_n.doc_states(keys)
+    for d in sent:
+        text = be_r.text(d, "s")
+        assert len(text) == sent[d], (d, len(text), sent[d])
+        assert text == be_n.text(d, "s"), d
+        for name, x, y in zip(
+            st_r[(d, "s")]._fields, st_r[(d, "s")], st_n[(d, "s")]
+        ):
+            assert bool(jnp.array_equal(x, y)), (d, name)
+    rm = be_r.residency
+    rs = rm.stats()
+    assert rs["hibernations"] >= 1 and rs["wakes"]["ok"] >= 1, rs
+    ops = sum(sent.values())
+    rec = {
+        "residency_wake_p99_ms": round(rm.wake_p99_ms(), 3),
+        "residency_hit_ratio": rs["hit_ratio"],
+        "residency_corpus_docs": corpus,
+        "residency_distinct_docs": len(sent),
+        "residency_slot_budget": slots,
+        "residency_hibernations": rs["hibernations"],
+        "residency_wakes": rs["wakes"],
+        "residency_ops_per_sec": round(ops / el_r, 1),
+        "residency_parity": "bit-identical vs never-evicted",
+        "residency_shape": f"{rounds}x{fpr}x{k}",
+    }
+    print(json.dumps({"metric": "residency_wake_p99_ms", **rec}))
+    return rec
+
+
 def serving_benchmarks(on_tpu: bool) -> dict:
     """The serving-path headline numbers, captured IN the driver artifact
     (VERDICT r5 Weak #1/#2: a number that isn't in a committed BENCH_*.json
@@ -1570,6 +1710,13 @@ def serving_benchmarks(on_tpu: bool) -> dict:
         out.update(read_fanout_benchmark(on_tpu))
     except Exception as e:  # noqa: BLE001
         out["serving_error_read_fanout"] = repr(e)[:500]
+    try:
+        # r19: fleet-as-cache — the million-doc corpus over a bounded
+        # slot budget, hibernation/wake churn parity-pinned against a
+        # never-evicted lane, zero lost/dup asserted in-bench.
+        out.update(residency_benchmark(on_tpu))
+    except Exception as e:  # noqa: BLE001
+        out["serving_error_residency"] = repr(e)[:500]
     try:
         import bench_configs as BC
 

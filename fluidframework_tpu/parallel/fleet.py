@@ -423,6 +423,33 @@ def _np_batched_state(n_docs: int, capacity: int) -> SegmentState:
     )
 
 
+@jax.jit
+def _blank_slots(state: SegmentState, slots, empty: SegmentState):
+    """Blank a batch of vacated slots ON DEVICE (r19 hibernation evicts
+    at cache-churn rates — a whole-pool host round trip per eviction
+    would put O(pool) transfers on every sweep). ``empty`` is a
+    one-row :func:`_np_batched_state` template; row 0 broadcasts over
+    the slot batch per field."""
+    return SegmentState(
+        *[
+            getattr(state, f).at[slots].set(getattr(empty, f)[0])
+            for f in SegmentState._fields
+        ]
+    )
+
+
+@jax.jit
+def _write_slot(state: SegmentState, slot, doc: SegmentState):
+    """Write one document's [S]-lane state into a pool slot ON DEVICE —
+    the wake path uploads the document (KBs), not the pool (MBs)."""
+    return SegmentState(
+        *[
+            getattr(state, f).at[slot].set(getattr(doc, f))
+            for f in SegmentState._fields
+        ]
+    )
+
+
 
 
 class _Pool:
@@ -447,6 +474,13 @@ class _Pool:
         # changes, so a one-boxcar-stale health scan cannot attribute a
         # departed doc's count/err to the slot's new occupant.
         self.slot_gen = np.zeros(n_slots, np.int64)
+        # Explicit slot free-list (r19): with hibernation churning slots
+        # at fleet-as-cache rates the O(n_slots) flatnonzero scan per
+        # allocation is a measurable host tax. Entries are validated
+        # against doc_of_slot on pop (a slot may be handed out through a
+        # path that never popped it), so a stale entry skips instead of
+        # double-allocating; an exhausted list falls back to the scan.
+        self._free: List[int] = list(range(n_slots - 1, -1, -1))
         if kernel == "pallas" and sharding is not None:
             self._step = self._mesh_pallas_apply
             self._compact = self._mesh_pallas_zamboni
@@ -536,8 +570,22 @@ class _Pool:
         return jax.device_put(host, self.sharding)
 
     def free_slot(self) -> Optional[int]:
+        while self._free:
+            s = self._free.pop()
+            if self.doc_of_slot[s] < 0:
+                return s
+        # Free-list dry but slots may have been vacated through a path
+        # that never released them: refill from one scan.
         free = np.flatnonzero(self.doc_of_slot < 0)
-        return int(free[0]) if free.size else None
+        if not free.size:
+            return None
+        self._free = [int(s) for s in free[::-1]]
+        return self._free.pop()
+
+    def release_slot(self, slot: int) -> None:
+        """Push a vacated slot onto the free-list (the caller already
+        blanked it and cleared doc_of_slot)."""
+        self._free.append(int(slot))
 
     def n_free(self) -> int:
         return int(np.sum(self.doc_of_slot < 0))
@@ -565,6 +613,7 @@ class _Pool:
         self.slot_gen = np.concatenate(
             [self.slot_gen, np.zeros(extra, np.int64)]
         )
+        self._free.extend(range(self.n_slots + extra - 1, self.n_slots - 1, -1))
         self.n_slots += extra
 
 
@@ -582,9 +631,19 @@ class DocFleet:
         kernel: str = "auto",
         mesh=None,
         axis: str = "docs",
+        low_water: float = 0.2,
     ):
         self.n_docs = n_docs
         self.high_water = high_water
+        # Demotion threshold (r19, the inverse of the promotion walk): a
+        # doc whose live rows fall below ``low_water * cap`` steps down
+        # one tier. low_water must sit below high_water/2 so the stale-
+        # scan growth bound still holds in the SMALLER tier: a one-
+        # boxcar-stale count c < low_water*cap can grow by at most half
+        # the smaller tier's headroom ((1-high_water)*cap/4) before the
+        # move lands, and low_water*cap + that must stay under
+        # high_water*(cap/2) — 0.2 and 0.75 leave 0.0875*cap of margin.
+        self.low_water = low_water
         self.max_capacity = max_capacity
         self.base_capacity = capacity
         # Mesh-sharded serving fleet (SURVEY.md:13-15 — "per-partition
@@ -619,6 +678,7 @@ class DocFleet:
         self._place_dirty = True
         self._cap_arr = self._slot_arr = None
         self.migrations = 0
+        self.demotions = 0
         self.last_routing_s = 0.0
 
     def _place_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
@@ -841,13 +901,27 @@ class DocFleet:
         errs = 0
         rows = 0
         for pool in self.pools.values():
-            err = np.asarray(pool.state.err)  # graftlint: readback(stats() is the explicit synchronous health API; serving rides begin_scan/finish_scan)
-            cnt = np.asarray(pool.state.count)  # graftlint: readback(same synchronous stats pull)
+            # A concurrent serving step DONATES the pool state: between
+            # fetching ``pool.state`` and the readback the old buffers
+            # can be deleted under us. stats() is the explicit
+            # synchronous health API — callers poll it from outside the
+            # serving loop — so re-fetch the live state and retry
+            # instead of surfacing a transient deleted-array error.
+            for attempt in range(8):
+                st = pool.state
+                try:
+                    err = np.asarray(st.err)  # graftlint: readback(stats() is the explicit synchronous health API; serving rides begin_scan/finish_scan)
+                    cnt = np.asarray(st.count)  # graftlint: readback(same synchronous stats pull)
+                    break
+                except RuntimeError:
+                    if attempt == 7:
+                        raise
             live = pool.live_slots()
             errs += int(np.sum(err[live] != 0))
             rows += int(np.sum(cnt[live]))
         return {"docs_with_errors": errs, "rows_in_use": rows,
-                "migrations": self.migrations, "pools": sorted(self.pools)}
+                "migrations": self.migrations, "demotions": self.demotions,
+                "pools": sorted(self.pools)}
 
     # -- capacity lifecycle ---------------------------------------------------
 
@@ -912,6 +986,7 @@ class DocFleet:
                 getattr(src_host, s)[slot] = np.asarray(getattr(empty, s))[0]
             pool.doc_of_slot[slot] = -1
             pool.slot_gen[slot] += 1
+            pool.release_slot(slot)
             dst.doc_of_slot[dst_slot] = doc
             dst.slot_gen[dst_slot] += 1
             self.placement[doc] = (new_cap, dst_slot)
@@ -919,6 +994,118 @@ class DocFleet:
         self._place_dirty = True
         pool.state = pool._put(src_host)
         dst.state = dst._put(dst_host)
+
+    def check_and_demote(
+        self,
+        counts: Optional[Dict[int, np.ndarray]] = None,
+        max_moves: int = 32,
+    ) -> List[int]:
+        """Host-driven demotion pass — the inverse of the promotion walk:
+        move docs whose live rows fell below ``low_water * cap`` down one
+        capacity tier, so a cooling doc releases HBM in steps before
+        hibernation takes it out entirely. ``counts`` substitutes for the
+        synchronous readback exactly as in :meth:`check_and_migrate`; a
+        one-boxcar-stale trigger is sound because the fresh post-compact
+        host copy re-verifies the fit before any row is moved (a doc that
+        heated back up in the gap simply stays put). ``max_moves`` bounds
+        the host copies per pass — demotion is a background economy, not
+        a correctness deadline, so the rest waits for the next sweep."""
+        demoted: List[int] = []
+        for cap in sorted(self.pools, reverse=True):
+            if len(demoted) >= max_moves:
+                break
+            pool = self.pools[cap]
+            if cap // 2 < self.base_capacity:
+                continue
+            c = counts.get(cap) if counts is not None else None
+            cold_slots = self._cold_slots(pool, cap, c)
+            budget = max_moves - len(demoted)
+            cold = [
+                (int(s), int(pool.doc_of_slot[s]))
+                for s in cold_slots[:budget]
+            ]
+            if not cold:
+                continue
+            demoted.extend(self._demote_batch(pool, cap, cold))
+        return demoted
+
+    def _demote_batch(
+        self, pool, cap: int, cold: List[Tuple[int, int]]
+    ) -> List[int]:
+        """Demote the cold docs of one pool in ONE host copy + ONE upload
+        per pool, mirroring :meth:`_promote_batch`. The source pool is
+        compacted first so every live row sits in ``[0, count)`` and the
+        truncating copy into the half-width tier is exact; each doc's
+        fit is then re-verified against the fresh host copy (stale-scan
+        candidates that no longer fit, or whose sticky err lane fired,
+        are skipped — moving corrupt state would launder the error)."""
+        new_cap = cap // 2
+        pool.state = pool._compact(pool.state)
+        dst = self.pools.get(new_cap)
+        if dst is None:
+            dst = self.pools[new_cap] = _Pool(
+                new_cap, _pow2_at_least(len(cold)), self.kernel,
+                self._sharding,
+            )
+        while dst.n_free() < len(cold):
+            dst.grow_slots()
+        # graftlint: readback(demotion migrates docs host-side: one copy + one upload per pool, rare by the low-water design)
+        src_host = SegmentState(*[np.array(x) for x in pool.state])
+        dst_host = SegmentState(*[np.array(x) for x in dst.state])  # graftlint: readback(same demotion copy)
+        empty = _np_batched_state(1, cap)
+        free = [int(s) for s in np.flatnonzero(dst.doc_of_slot < 0)]
+        moved: List[int] = []
+        fi = 0
+        for slot, doc in cold:
+            n = int(src_host.count[slot])
+            if int(src_host.err[slot]) != 0 or n > self.high_water * new_cap:
+                continue
+            dst_slot = free[fi]
+            fi += 1
+            for lane in SEGMENT_LANES:
+                src = getattr(src_host, lane)[slot]
+                d = getattr(dst_host, lane)
+                fill = KIND_FREE if lane == "kind" else (
+                    RSEQ_NONE if lane == "rseq" else 0
+                )
+                d[dst_slot, :n] = src[:n]
+                d[dst_slot, n:] = fill
+                getattr(src_host, lane)[slot] = np.asarray(
+                    getattr(empty, lane)
+                )[0]
+            for s in _SCALARS:
+                getattr(dst_host, s)[dst_slot] = getattr(src_host, s)[slot]
+                getattr(src_host, s)[slot] = np.asarray(getattr(empty, s))[0]
+            pool.doc_of_slot[slot] = -1
+            pool.slot_gen[slot] += 1
+            pool.release_slot(slot)
+            dst.doc_of_slot[dst_slot] = doc
+            dst.slot_gen[dst_slot] += 1
+            self.placement[doc] = (new_cap, dst_slot)
+            self.demotions += 1
+            moved.append(doc)
+        if moved:
+            self._place_dirty = True
+            pool.state = pool._put(src_host)
+            dst.state = dst._put(dst_host)
+        return moved
+
+    def _cold_slots(
+        self, pool: _Pool, cap: int, counts: Optional[np.ndarray] = None
+    ) -> np.ndarray:
+        """Live slots below the low-water mark — the demotion predicate
+        (the half-width fit itself is re-checked post-compact against a
+        fresh host copy in :meth:`_demote_batch`)."""
+        if counts is None:
+            counts = np.asarray(pool.state.count)  # graftlint: readback(synchronous fallback when no begin_scan token was supplied)
+        if len(counts) < pool.n_slots:
+            counts = np.concatenate(
+                [counts, np.zeros(pool.n_slots - len(counts), np.int32)]
+            )
+        return np.flatnonzero(
+            (pool.doc_of_slot >= 0)
+            & (counts[: pool.n_slots] < self.low_water * cap)
+        )
 
     def _hot_slots(
         self, pool: _Pool, cap: int, counts: Optional[np.ndarray] = None
@@ -973,9 +1160,67 @@ class DocFleet:
         pool.state = pool._put(host)
         pool.doc_of_slot[slot] = -1
         pool.slot_gen[slot] += 1
+        pool.release_slot(slot)
         self.placement[doc] = None
         self._place_dirty = True
         return state
+
+    def restore_doc(self, doc: int, state: SegmentState) -> None:
+        """Re-admit an evicted document from a host-side state — the
+        inverse of :meth:`evict_doc` (residency wake, or a ShardedDoc
+        stepping back into the fleet). The doc keeps its dense id; its
+        capacity tier is read off the state's lane width, so a doc that
+        hibernated from a promoted tier wakes into that tier."""
+        assert self.placement[doc] is None, (
+            f"restore_doc({doc}): doc is still placed"
+        )
+        cap = int(np.asarray(state.kind).shape[-1])
+        pool = self.pools.get(cap)
+        if pool is None:
+            pool = self.pools[cap] = _Pool(
+                cap, 1, self.kernel, self._sharding
+            )
+        slot = pool.free_slot()
+        if slot is None:
+            pool.grow_slots()
+            slot = pool.free_slot()
+        pool.state = _write_slot(pool.state, slot, state)
+        pool.doc_of_slot[slot] = doc
+        pool.slot_gen[slot] += 1
+        self.placement[doc] = (cap, slot)
+        self._place_dirty = True
+
+    def evict_docs(
+        self,
+        docs: List[int],
+        states: Optional[Dict[int, SegmentState]] = None,
+    ) -> Dict[int, SegmentState]:
+        """Batched :meth:`evict_doc` (r19 hibernation): states come from
+        ONE batched device gather (or from ``states`` when the caller
+        already ran that gather's transfer off-loop), and the vacated
+        slots blank through one device-side scatter per pool — never a
+        whole-pool host round trip per document."""
+        if states is None:
+            states = self.doc_states(docs)
+        by_pool: Dict[int, List[int]] = {}
+        for d in docs:
+            cap, _slot = self.placement[d]
+            by_pool.setdefault(cap, []).append(d)
+        for cap, group in by_pool.items():
+            pool = self.pools[cap]
+            slots = np.array(
+                [self.placement[d][1] for d in group], np.int64
+            )
+            pool.state = _blank_slots(
+                pool.state, slots, _np_batched_state(1, cap)
+            )
+            for d, s in zip(group, slots):
+                pool.doc_of_slot[s] = -1
+                pool.slot_gen[s] += 1
+                pool.release_slot(int(s))
+                self.placement[d] = None
+        self._place_dirty = True
+        return states
 
     # -- introspection --------------------------------------------------------
 

@@ -46,6 +46,7 @@ offset zero (the scribe rebuild model, ``scribe/lambda.ts:106``).
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
@@ -66,6 +67,7 @@ from fluidframework_tpu.parallel.fleet import (
 )
 from fluidframework_tpu.protocol.constants import F_ARG, F_SEQ, OP_WIDTH
 from fluidframework_tpu.service import retry
+from fluidframework_tpu.service.residency import HeatTracker, ResidencyManager
 from fluidframework_tpu.telemetry import journal, metrics, profiler, tracing
 from fluidframework_tpu.testing import faults
 from fluidframework_tpu.testing.faults import inject_fault
@@ -147,6 +149,8 @@ class DeviceFleetBackend:
         pump_mode: bool = True,
         ring_depth: int = 2,
         feed_deadline_ms: float = 3.0,
+        max_resident: int = 0,
+        wake_pending_max: int = 4096,
     ):
         # ``mesh``: shard every fleet pool's document axis over a
         # jax.sharding.Mesh — the serving deployment shape (per-partition
@@ -259,6 +263,37 @@ class DeviceFleetBackend:
         self._feed_edge: Optional[float] = None
         self.feed_triggers: Dict[str, int] = {"size": 0, "deadline": 0}
         self._scan_prefetch: Optional[Tuple[object, Dict[int, np.ndarray]]] = None
+        # Fleet-as-cache (r19): the residency manager owns the per-doc
+        # RESIDENT → IDLE → HIBERNATING → COLD → WAKING lifecycle;
+        # ``max_resident`` (0 = unbounded) is the slot budget that turns
+        # the fleet into a managed cache over the durable tier. _cold
+        # holds each hibernated channel's exact evicted SegmentState +
+        # applied head — the wake path restores it bit-identically and
+        # serves reads from it without waking; a process crash loses
+        # these records and falls back to the existing full-log
+        # crash-rebuild (crash_device), with the durable summary pointer
+        # the hibernate commit landed in LatestSummaryCache bounding
+        # that replay. _parked buffers rows addressed to a COLD/WAKING
+        # doc: they must NOT enter _buffers (dispatch_staged drops rows
+        # routed to an evicted slot — caps <= 0 — silently), so they
+        # park at the enqueue boundary, bounded by wake_pending_max,
+        # never dropped, never reordered (per-channel arrival order =
+        # seq order, the gapless 1..head contract).
+        self.residency = ResidencyManager(
+            max_resident=max_resident, heat=HeatTracker(),
+            wake_pending_max=wake_pending_max,
+        )
+        self._cold: Dict[ChannelKey, Tuple[object, int]] = {}
+        # Serializes wake commits across threads (the server loop's
+        # submit-path wake vs a direct caller's flush retry): exactly
+        # one waker may claim a cold record and restore it. Readers
+        # stay lock-free — restore-before-delete ordering guarantees
+        # they find the cold record or a live slot at every instant.
+        self._wake_mu = threading.Lock()
+        self._doc_channels: Dict[str, List[ChannelKey]] = {}
+        self._parked: Dict[int, List[np.ndarray]] = {}
+        self._parked_rows = 0
+        self.hibernations = 0
         # Warm the first-flush kernel shapes NOW (throwaway fleets at the
         # first few slot buckets x the minimum K bucket): the first
         # compile otherwise lands inside a serving flush — synchronous in
@@ -307,6 +342,8 @@ class DeviceFleetBackend:
             self._index[key] = idx
             self._keys.append(key)
             self.payloads[key] = {}
+            self._doc_channels.setdefault(doc_id, []).append(key)
+            self.residency.note_admit(doc_id)
             if len(self._keys) > self._applied_a.shape[0]:
                 # Amortized doubling of the watermark arrays.
                 grow = max(64, self._applied_a.shape[0])
@@ -349,6 +386,11 @@ class DeviceFleetBackend:
         seq = int(row[F_SEQ])
         if seq <= self._applied_a[idx] or seq <= self._buffseq_a[idx]:
             return
+        if not self.residency.note_op(doc_id):
+            # COLD/WAKING doc: the row must not enter _buffers (its slot
+            # is evicted — dispatch would drop it). Park + attempt wake.
+            self._park(idx, doc_id, row[None, :])
+            return
         self._buffseq_a[idx] = seq
         if not self._buffered_rows:
             self._feed_edge = time.perf_counter()
@@ -384,6 +426,11 @@ class DeviceFleetBackend:
             else:
                 origs, texts = frame.insert_payloads()
             self.payloads[key].update(zip(origs.tolist(), texts))
+        if not self.residency.note_op(doc_id, float(rows.shape[0])):
+            # Payloads are already landed (wake needs them); the rows
+            # park until the doc's slot is restored.
+            self._park(idx, doc_id, rows)
+            return
         self._buffseq_a[idx] = int(rows[-1, F_SEQ])
         if not self._buffered_rows:
             self._feed_edge = time.perf_counter()
@@ -418,6 +465,219 @@ class DeviceFleetBackend:
             self.pump_feed_absorbed()
         else:
             self.flush()
+
+    # -- residency: fleet-as-cache (r19) ---------------------------------------
+    #
+    # The fleet's HBM slots are a managed cache over the durable tier:
+    # the sweep (pipeline pump / network deadline ticker) summarizes an
+    # idle doc, lands the pointer in LatestSummaryCache, then calls
+    # hibernate_doc() to free the slots; the first op to a COLD doc
+    # wakes it through _park/_try_wake — the bounded-latency miss path.
+    # Invariant: a row addressed to a COLD/WAKING doc NEVER enters
+    # _buffers (dispatch_staged silently drops rows routed to a slot
+    # with caps <= 0), and a doc with buffered, parked, or ring-staged
+    # rows NEVER hibernates — between the two, no op is lost.
+
+    def _park(self, idx: int, doc_id: str, rows: np.ndarray) -> None:
+        """Park sequenced rows for a COLD/WAKING doc and attempt the
+        wake inline. Parked rows advance the buffered high-water mark
+        (live redelivery duplicates still drop) but are excluded from
+        the boxcar until the slot is restored. The pending queue is
+        bounded by BACKPRESSURE, not by dropping: parked rows count
+        into ``pressure().queue_frac`` and ``needs_flush``, so the
+        admission envelope throttles the front door while a wake is
+        outstanding — the rows themselves are never discarded or
+        reordered (per-channel arrival order is seq order)."""
+        self._buffseq_a[idx] = max(
+            int(self._buffseq_a[idx]), int(rows[-1, F_SEQ])
+        )
+        self._parked.setdefault(idx, []).append(rows)
+        self._parked_rows += rows.shape[0]
+        self.residency.begin_wake(doc_id)
+        self._try_wake(doc_id)
+        if self._buffered_rows >= self.max_batch:
+            self._boxcar_full()
+
+    def _unpark(self, idx: int) -> None:
+        """Move a woken channel's parked rows into the boxcar buffers —
+        appended in arrival (= seq) order, so the gapless 1..head
+        contract the watermarks enforce is untouched."""
+        chunks = self._parked.pop(idx, None)
+        if not chunks:
+            return
+        n = sum(c.shape[0] for c in chunks)
+        if not self._buffered_rows:
+            self._feed_edge = time.perf_counter()
+        self._buffers.setdefault(idx, []).extend(chunks)
+        self._buffered_rows += n
+        self._parked_rows -= n
+
+    @inject_fault("doc.wake")
+    def _wake_commit(self, doc_id: str) -> bool:
+        """Restore every COLD channel of ``doc_id`` to a fleet slot and
+        release its parked rows. Idempotent: a channel whose cold record
+        is already gone (a crash landed AFTER a previous attempt's
+        restore) just unparks — the retry-as-noop half of the
+        ``doc.wake`` recovery contract."""
+        woke = False
+        for key in self._doc_channels.get(doc_id, ()):
+            idx = self._index[key]
+            with self._wake_mu:
+                rec = self._cold.get(key)
+                if rec is not None:
+                    # Restore BEFORE dropping the cold record: a
+                    # concurrent snapshot read (read_start checks _cold,
+                    # then resolves placement) must find one or the
+                    # other at every instant — pop-then-restore left a
+                    # window where it found neither and the gather
+                    # raised on the evicted slot. The lock keeps the
+                    # claim single-winner: a second waker sees the
+                    # record gone and nops instead of re-restoring a
+                    # stale state over already-landed ops.
+                    self.fleet.restore_doc(idx, rec[0])
+                    del self._cold[key]
+                    woke = True
+            self._unpark(idx)
+        return woke
+
+    def _try_wake(self, doc_id: str) -> bool:
+        """Run one wake attempt with the ``doc.wake`` recovery contract:
+        an injected failure leaves the durable/cold state untouched and
+        the rows parked (the next op or the quiescence flush retries);
+        a crash after the restore is finished as a completed wake before
+        the crash propagates (the slot is live — the retry would noop)."""
+        head = max(
+            (int(self._applied_a[self._index[k]])
+             for k in self._doc_channels.get(doc_id, ())),
+            default=-1,
+        )
+        try:
+            woke = self._wake_commit(doc_id)
+        except faults.InjectedCrash as e:
+            if e.completed:
+                self.residency.finish_wake(doc_id, "ok", head=head)
+            else:
+                self.residency.finish_wake(doc_id, "retry")
+            raise
+        except faults.InjectedFault:
+            self.residency.finish_wake(doc_id, "retry")
+            retry.retry_counter().inc(site="doc.wake", outcome="retry")
+            if journal._ON:
+                journal.record(
+                    "retry.outcome", site="doc.wake", outcome="retry"
+                )
+            return False
+        self.residency.finish_wake(
+            doc_id, "ok" if woke else "noop", head=head
+        )
+        return True
+
+    def _retry_parked_wakes(self) -> None:
+        """The quiescence backstop: re-attempt the wake behind every
+        parked channel (a disarmed fault must not strand parked rows
+        waiting for future traffic — the drain contract)."""
+        for idx in list(self._parked):
+            doc_id = self._keys[idx][0]
+            if self.residency.is_cold(doc_id):
+                self.residency.begin_wake(doc_id)
+                self._try_wake(doc_id)
+
+    def _hibernate_plan(
+        self, doc_id: str,
+    ) -> Optional[Tuple[List[ChannelKey], List[int]]]:
+        """The doc's (keys, idxs) when every channel is eligible to
+        hibernate, else None. Ineligible: buffered or parked rows (they
+        would route to an evicted slot and silently drop), rows staged
+        in the ingest ring, sharded overflow, a tripped err lane (the
+        nack must surface first), or an already-evicted slot."""
+        keys = self._doc_channels.get(doc_id, [])
+        if not keys:
+            return None
+        staged_docs: set = set()
+        for slot in self._ring.staged:
+            staged_docs.update(int(d) for d in slot.docs)
+        idxs: List[int] = []
+        for key in keys:
+            idx = self._index[key]
+            if (
+                self.fleet.placement[idx] is None
+                or idx in self._sharded
+                or idx in self._errored
+                or idx in self._buffers
+                or idx in self._parked
+                or idx in staged_docs
+            ):
+                return None
+            idxs.append(idx)
+        return keys, idxs
+
+    def hibernate_eligible(self, doc_id: str) -> bool:
+        """Cheap pre-check for the sweep: whether :meth:`hibernate_doc`
+        would proceed — so the sweep only pays the summarize + durable
+        put for documents that can actually evict."""
+        return self._hibernate_plan(doc_id) is not None
+
+    def hibernate_doc(
+        self, doc_id: str, states: Optional[dict] = None,
+    ) -> bool:
+        """Evict one idle doc's channels from the fleet, retaining the
+        exact evicted states as the in-RAM cold tier. The caller (the
+        hibernation sweep) has already summarized the doc and landed the
+        durable pointer in LatestSummaryCache — a process crash after
+        that point rebuilds through the existing crash-replay path, so
+        these records are a cache of the durable tier, not the durable
+        tier itself. ``states`` may carry the sweep's batched
+        key->SegmentState gather so the commit skips a second readback.
+        Returns False (doc untouched, RESIDENT) when any channel is
+        ineligible: buffered/parked rows, staged ring rows, sharded
+        overflow, a tripped err lane, or an already-evicted slot."""
+        plan = self._hibernate_plan(doc_id)
+        if plan is None:
+            return False
+        keys, idxs = plan
+        if not self.residency.begin_hibernate(doc_id):
+            return False
+        head = max(int(self._applied_a[i]) for i in idxs)
+        try:
+            self._hibernate_commit(doc_id, keys, idxs, states)
+        except faults.InjectedCrash as e:
+            # Crash AFTER the commit: the doc is durably cold (slots
+            # freed, records landed) — finish as a completed hibernate
+            # so the post-crash state machine matches reality. Before:
+            # nothing happened — the doc simply stays RESIDENT.
+            self.residency.finish_hibernate(doc_id, ok=e.completed, head=head)
+            raise
+        except faults.InjectedFault:
+            self.residency.finish_hibernate(doc_id, ok=False)
+            retry.retry_counter().inc(
+                site="doc.hibernate", outcome="fallback"
+            )
+            if journal._ON:
+                journal.record(
+                    "retry.outcome", site="doc.hibernate",
+                    outcome="fallback",
+                )
+            return False
+        self.residency.finish_hibernate(doc_id, ok=True, head=head)
+        self.hibernations += 1
+        return True
+
+    @inject_fault("doc.hibernate")
+    def _hibernate_commit(
+        self, doc_id: str, keys: List[ChannelKey], idxs: List[int],
+        states: Optional[dict],
+    ) -> None:
+        st: Optional[Dict[int, object]] = None
+        if states is not None:
+            st = {
+                self._index[k]: states[k] for k in keys if k in states
+            }
+            if len(st) != len(idxs):
+                st = None  # partial gather: re-gather inside the fleet
+        ev = self.fleet.evict_docs(idxs, st)
+        for key, idx in zip(keys, idxs):
+            self._cold[key] = (ev[idx], int(self._applied_a[idx]))
+            self._since_a[idx] = 0
 
     # -- the boxcar step -------------------------------------------------------
 
@@ -455,6 +715,8 @@ class DeviceFleetBackend:
         late still fires before the doc can overflow.
         ``last_flush_breakdown`` / ``flush_totals`` record where the wall
         went (host staging vs upload+dispatch)."""
+        if self._parked_rows:
+            self._retry_parked_wakes()
         if self.pump_mode:
             return self._flush_pump()
         return self._flush_oneshot()
@@ -1080,7 +1342,11 @@ class DeviceFleetBackend:
             lag_ms = (time.perf_counter() - self._feed_edge) * 1e3
         return PressureSignal(
             ring_frac=len(self._ring) / self._ring.depth,
-            queue_frac=self._buffered_rows / max(1, self.max_batch),
+            # Parked wake-pending rows count as queue depth: the bounded
+            # pending queue is bounded by THIS backpressure (admission
+            # throttles the front door), never by dropping rows.
+            queue_frac=(self._buffered_rows + self._parked_rows)
+            / max(1, self.max_batch),
             feed_lag_ms=lag_ms,
             scan_inflight=self._scan_token is not None,
         )
@@ -1096,6 +1362,7 @@ class DeviceFleetBackend:
             self._buffered_rows >= max(1, int(min_rows))
             or len(self._ring) > 0
             or bool(self._unreported)
+            or self._parked_rows > 0
         )
 
     def needs_scan_drain(self) -> bool:
@@ -1203,6 +1470,10 @@ class DeviceFleetBackend:
         counts = {cap: s[0] for cap, s in scans.items()}
         errs = {cap: s[1] for cap, s in scans.items()}
         self.fleet.check_and_migrate(counts)
+        # Demotion (r19) rides the SAME one-boxcar-stale scan counts the
+        # promotion walk consumes — a cooling doc steps down tiers with
+        # zero additional readbacks.
+        self.fleet.check_and_demote(counts)
         if self.sharded_overflow:
             self._promote_overflow()
         newly_errored.extend(self._collect_errors(errs))
@@ -1281,6 +1552,9 @@ class DeviceFleetBackend:
     def _doc_state(self, idx: int):
         if idx in self._sharded:
             return self._sharded[idx].to_single()
+        key = self._keys[idx]
+        if key in self._cold:
+            return self._cold[key][0]
         return self.fleet.doc_state(idx)
 
     # -- the read path ---------------------------------------------------------
@@ -1302,8 +1576,16 @@ class DeviceFleetBackend:
             idx: self._sharded[idx].to_single()
             for _key, idx in order if idx in self._sharded
         }
+        # COLD channels serve straight from their retained cold records
+        # — a read never wakes a doc (only the submit path does), and
+        # the record IS the exact evicted device state.
+        cold = {
+            idx: self._cold[key][0]
+            for key, idx in order if key in self._cold
+        }
         fleet_idxs = [
-            idx for _key, idx in order if idx not in sharded
+            idx for _key, idx in order
+            if idx not in sharded and idx not in cold
         ]
         dev = layout = fallback = None
         if fleet_idxs:
@@ -1330,8 +1612,8 @@ class DeviceFleetBackend:
             else:
                 self.read_gathers += 1
         return {
-            "order": order, "sharded": sharded, "dev": dev,
-            "layout": layout, "fallback": fallback,
+            "order": order, "sharded": sharded, "cold": cold,
+            "dev": dev, "layout": layout, "fallback": fallback,
         }
 
     @inject_fault("read.gather")
@@ -1366,6 +1648,7 @@ class DeviceFleetBackend:
                 DocFleet.doc_states_finish(host, token["layout"])
             )
         states.update(token["sharded"])
+        states.update(token.get("cold") or {})
         self.reads_served += len(token["order"])
         return {key: states[idx] for key, idx in token["order"]}
 
@@ -1548,6 +1831,8 @@ class DeviceFleetBackend:
             "reads_per_device_dispatch",
             "snapshot reads served per batched device gather dispatch",
         ).set(round(self.reads_per_device_dispatch, 3))
+        # Residency (r19): per-state doc counts, wake outcomes, hit ratio.
+        self.residency.publish_metrics(reg)
         return tel
 
     def stats(self) -> dict:
@@ -1576,5 +1861,9 @@ class DeviceFleetBackend:
             reads_per_device_dispatch=round(
                 self.reads_per_device_dispatch, 3
             ),
+            hibernations=self.hibernations,
+            cold_channels=len(self._cold),
+            parked_rows=self._parked_rows,
+            residency=self.residency.stats(),
         )
         return s

@@ -33,6 +33,7 @@ from fluidframework_tpu.protocol.types import (
 )
 from fluidframework_tpu.service import retry
 from fluidframework_tpu.service.pipeline import ReservationManager
+from fluidframework_tpu.service.residency import HeatTracker
 from fluidframework_tpu.service.sequencer import (
     DocumentSequencer,
     SequencerCheckpoint,
@@ -148,8 +149,17 @@ class OrderingNode:
         # Load accounting (reference partitionManager.ts:25 — the consumer
         # group rebalances by observed lag/throughput): decayed recent op
         # count per owned document; the cluster's rebalance pass reads and
-        # ages these.
-        self.op_rate: Dict[str, float] = {}
+        # ages these. The accumulator is the shared HeatTracker so the
+        # rebalancer and single-node residency score heat identically,
+        # and rebalance ordering uses the window-normalized rate() (raw
+        # accumulators over-weight aged documents vs brand-new ones).
+        self.heat = HeatTracker()
+
+    @property
+    def op_rate(self) -> Dict[str, float]:
+        """Raw decayed op counts per tracked document — the pre-r19 dict
+        shape, kept as a read-only view over the HeatTracker."""
+        return {d: self.heat.raw(d) for d in self.heat.docs()}
 
     # -- placement -----------------------------------------------------------
 
@@ -219,13 +229,13 @@ class OrderingNode:
         self.alive = False
         self._docs.clear()
         self._epochs.clear()
-        self.op_rate.clear()
+        self.heat = HeatTracker(decay=self.heat.decay)
 
     def load(self) -> float:
         """Recent-op load over owned documents (+1 per doc so ownership
         itself weighs: many idle docs still cost catch-up state)."""
         return sum(
-            self.op_rate.get(d, 0.0) + 1.0 for d in self._docs
+            self.heat.raw(d) + 1.0 for d in self._docs
         )
 
     def release_doc(self, doc_id: str) -> bool:
@@ -243,7 +253,7 @@ class OrderingNode:
         self._docs.pop(doc_id, None)
         self._epochs.pop(doc_id, None)
         self._since_cp.pop(doc_id, None)
-        self.op_rate.pop(doc_id, None)
+        self.heat.forget(doc_id)
         return True
 
     # -- sequencing ----------------------------------------------------------
@@ -254,9 +264,9 @@ class OrderingNode:
             # Fenced: someone took over. Forget the document.
             self._docs.pop(doc_id, None)
             self._epochs.pop(doc_id, None)
-            self.op_rate.pop(doc_id, None)
+            self.heat.forget(doc_id)
             return False
-        self.op_rate[doc_id] = self.op_rate.get(doc_id, 0.0) + 1.0
+        self.heat.touch(doc_id)
         self._since_cp[doc_id] = self._since_cp.get(doc_id, 0) + 1
         if self._since_cp[doc_id] >= self.checkpoint_every:
             self.checkpoints.save(
@@ -382,9 +392,18 @@ class NodeCluster:
                 break
             if len(hot._docs) < 2:
                 break
+            # Pick by the window-normalized rate, not the raw accumulator:
+            # raw values only compare between documents of equal age (an
+            # aged steady writer holds ~r/(1-decay) while a new one holds
+            # its first window's count), so the raw key mis-ranked young
+            # hot documents below old lukewarm ones.
             doc_id = max(
-                hot._docs, key=lambda d: hot.op_rate.get(d, 0.0)
+                hot._docs, key=lambda d: hot.heat.rate(d)
             )
+            # Export heat BEFORE release_doc forgets it: the migrated
+            # document keeps its age-normalization on the new owner
+            # instead of restarting cold.
+            moved_heat = hot.heat.export(doc_id)
             if not hot.release_doc(doc_id):
                 break
             if not cold.try_own(doc_id):  # pragma: no cover - cold is live
@@ -395,10 +414,10 @@ class NodeCluster:
                 if not hot.try_own(doc_id):
                     self.owner(doc_id)
                 break
+            cold.heat.adopt(doc_id, *moved_heat)
             moves.append((doc_id, hot.name, cold.name))
         for n in self.nodes:
-            for d in list(n.op_rate):
-                n.op_rate[d] *= decay
+            n.heat.observe_window(decay)
         return moves
 
 

@@ -321,6 +321,7 @@ class FluidNetworkServer:
         host: str = "127.0.0.1",
         port: int = 0,
         tenants: Optional[TenantManager] = None,
+        residency_sweep_s: float = 0.0,
     ):
         self.service = service if service is not None else LocalFluidService()
         self.host = host
@@ -374,6 +375,17 @@ class FluidNetworkServer:
         self._pending_reads: list = []
         self._reads_scheduled = False
         self.read_batches = 0
+        # The r19 off-loop hibernation sweep: every residency_sweep_s
+        # the deadline ticker runs one bounded residency sweep — idle
+        # detection and the hibernate walk, with the blocking halves
+        # (the batched state gather's device→host transfer, the durable
+        # summary put) in the executor and every backend mutation on
+        # the loop, the scan-prefetch split applied to hibernation.
+        # 0 = disabled (the default: an embedder opts in; the pipeline's
+        # synchronous hibernate_sweep() stays available either way).
+        self.residency_sweep_s = float(residency_sweep_s)
+        self._resid_sweep_edge = 0.0
+        self.residency_sweeps = 0
         # The r17 writer-loop offload: push byte writes drain on this
         # thread once the server is running (ROADMAP read-path
         # remainder). A server that never starts (in-proc tests driving
@@ -981,6 +993,26 @@ class FluidNetworkServer:
                         dev.ops_applied if dev is not None else None
                     )
                 )
+            # The r19 off-loop hibernation sweep rides the SAME ticker
+            # (it must run on idle ticks — idleness is exactly when
+            # documents hibernate), time-gated by residency_sweep_s.
+            if (
+                dev is not None
+                and self.residency_sweep_s > 0
+                and time.perf_counter() - self._resid_sweep_edge
+                >= self.residency_sweep_s
+            ):
+                self._resid_sweep_edge = time.perf_counter()
+                try:
+                    await self._residency_sweep(dev, loop)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    # Same supervisor contract as the feed tick: a
+                    # failed sweep (including an injected doc.hibernate
+                    # fault) must not kill future ticks — the doc simply
+                    # stays RESIDENT.
+                    pass
             if dev is None or not (
                 dev.needs_flush() or dev.needs_scan_drain()
             ):
@@ -1025,6 +1057,58 @@ class FluidNetworkServer:
             nack = getattr(self.service, "_nack_device_errors", None)
             if nack is not None:
                 nack()
+
+    async def _residency_sweep(
+        self, dev, loop, max_docs: int = 4,
+    ) -> None:
+        """One bounded hibernation sweep with the serving loop's
+        off-loop discipline: candidate selection, the batched-gather
+        device dispatch, and the evict commit run ON the loop
+        (serialized with the serving traffic — backend state is
+        loop-affine); the gather's device→host transfer and the durable
+        summary put run in the executor. Because the loop keeps serving
+        between those halves, an op may land on a candidate mid-sweep —
+        the applied-head recheck and hibernate_doc's own eligibility
+        guards make that a skip, never a lost op."""
+        svc = self.service
+        rm = getattr(dev, "residency", None)
+        if rm is None or not hasattr(svc, "doc_is_idle"):
+            return
+        self.residency_sweeps += 1
+        rm.heat.observe_window()
+        for doc_id in rm.resident_docs():
+            if svc.doc_is_idle(doc_id):
+                rm.mark_idle(doc_id)
+        for doc_id in rm.hibernation_candidates(want=max_docs):
+            if not dev.hibernate_eligible(doc_id):
+                continue
+            keys = [k for k in dev.channels() if k[0] == doc_id]
+            heads = {k: dev.applied_seq[k] for k in keys}
+            token = dev.read_start(keys)
+            host = None
+            if token["dev"] is not None:
+                host = await loop.run_in_executor(
+                    None, dev.read_transfer, token["dev"]
+                )
+            states = dev.read_finish(token, host)
+            summary = {
+                "channels": {
+                    addr: dev.summary_from_state((d, addr), st)
+                    for (d, addr), st in states.items()
+                },
+                "doc_id": doc_id,
+                "head": max(heads.values()),
+            }
+            handle = await loop.run_in_executor(
+                None, svc.store.put_summary, summary
+            )
+            if any(dev.applied_seq[k] != heads[k] for k in keys):
+                # Ops applied while the blocking halves streamed: the
+                # gathered states are stale. Skip — the doc went busy
+                # anyway, and the next sweep re-candidates it.
+                continue
+            svc.read_tier.latest.update(doc_id, handle)
+            dev.hibernate_doc(doc_id, states)
 
     def _authorized(self, params: dict, doc_id: str) -> bool:
         if self.tenants is None:

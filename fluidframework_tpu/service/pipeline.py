@@ -145,6 +145,7 @@ class PipelineFluidService:
         device_pump: bool = True,
         device_ring_depth: int = 2,
         device_feed_deadline_ms: float = 3.0,
+        device_max_resident: int = 0,
         foreman_tasks: tuple = ("summarizer",),
         index_sink: Optional[Any] = None,
         log: Optional[Any] = None,
@@ -268,14 +269,14 @@ class PipelineFluidService:
                 device_capacity, device_max_capacity,
                 device_sharded_overflow, device_max_batch, device_mesh,
                 device_kernel, device_pump, device_ring_depth,
-                device_feed_deadline_ms,
+                device_feed_deadline_ms, device_max_resident,
             )
 
     def _make_device(
         self, capacity: int, max_capacity: int, sharded_overflow: bool,
         max_batch: int = 512, mesh=None, kernel: str = "auto",
         pump: bool = True, ring_depth: int = 2,
-        feed_deadline_ms: float = 3.0,
+        feed_deadline_ms: float = 3.0, max_resident: int = 0,
     ) -> None:
         from fluidframework_tpu.service.device_backend import (
             DeviceFleetBackend,
@@ -293,10 +294,11 @@ class PipelineFluidService:
             sharded_overflow=sharded_overflow, max_batch=max_batch,
             mesh=mesh, kernel=kernel, pump_mode=pump,
             ring_depth=ring_depth, feed_deadline_ms=feed_deadline_ms,
+            max_resident=max_resident,
         )
         self._device_capacity = (
             capacity, max_capacity, sharded_overflow, max_batch, mesh,
-            kernel, pump, ring_depth, feed_deadline_ms,
+            kernel, pump, ring_depth, feed_deadline_ms, max_resident,
         )
 
         def factory(p: int, state):
@@ -514,9 +516,81 @@ class PipelineFluidService:
     def crash_device(self) -> None:
         """Kill the device stage (fleet state and consumer offsets gone)
         and restart it cold: the new consumer replays the deltas log from
-        offset zero and deterministically rebuilds every channel replica."""
+        offset zero and deterministically rebuilds every channel replica.
+
+        Residency note (r19): the crash also loses the in-RAM cold-tier
+        records and the residency state machine — every replayed doc
+        re-admits RESIDENT. That is the documented recovery: cold records
+        are a cache of the durable tier (LatestSummaryCache pointer +
+        DocOpLog delta tail), and the replay rebuilds the same state the
+        wake path would have restored."""
         assert self.device is not None, "device backend disabled"
         self._make_device(*self._device_capacity)
+
+    # -- residency: the hibernation sweep (r19) --------------------------------
+
+    def _deli_doc(self, doc_id: str):
+        from fluidframework_tpu.service.queue import partition_of
+
+        p = partition_of(doc_id, self.log.n_partitions)
+        lam = self._deli._lambdas.get(p)
+        return None if lam is None else lam._docs.get(doc_id)  # type: ignore[attr-defined]
+
+    def doc_is_idle(self, doc_id: str) -> bool:
+        """The deli sequencer's client-lifecycle idleness signal: no live
+        clients (every client expired or departed — the state in which
+        the sequencer emits its NoClient system op). A doc the deli has
+        never sequenced has no clients either."""
+        dd = self._deli_doc(doc_id)
+        return dd is None or not dd.sequencer.clients
+
+    def hibernate_sweep(self, max_docs: int = 8) -> List[str]:
+        """One residency sweep: close a heat decay window, step clientless
+        RESIDENT docs to IDLE (the sequencer lifecycle signal), then for
+        each cold-enough candidate run the hibernate walk — summarize the
+        doc's channels from device state (the device-scribe producer),
+        land the durable pointer in the historian's LatestSummaryCache,
+        and evict the fleet slots. Bounded by ``max_docs`` per call so a
+        ticker can run it without an unbounded stall; returns the doc ids
+        hibernated. The serving loop never calls this inline — the
+        network server's deadline ticker and tests/benches do."""
+        if self.device is None:
+            return []
+        rm = self.device.residency
+        rm.heat.observe_window()
+        for doc_id in rm.resident_docs():
+            if self.doc_is_idle(doc_id):
+                rm.mark_idle(doc_id)
+        done: List[str] = []
+        for doc_id in rm.hibernation_candidates(want=max_docs):
+            if not self.device.hibernate_eligible(doc_id):
+                continue
+            if self._hibernate_one(doc_id):
+                done.append(doc_id)
+        return done
+
+    def _hibernate_one(self, doc_id: str) -> bool:
+        """The summarize→durable-pointer→evict walk for one document.
+        The batched channel gather doubles as the evict states (the
+        commit re-uses it — one readback for the whole walk)."""
+        device = self.device
+        keys = [k for k in device.channels() if k[0] == doc_id]
+        if not keys:
+            return False
+        states = device.doc_states(keys)
+        summary = {
+            "channels": {
+                addr: device.summary_from_state((d, addr), st)
+                for (d, addr), st in states.items()
+            },
+            "doc_id": doc_id,
+            "head": max(
+                device.applied_seq[k] for k in keys
+            ),
+        }
+        handle = self.store.put_summary(summary)
+        self.read_tier.latest.update(doc_id, handle)
+        return device.hibernate_doc(doc_id, states)
 
     # -- the LocalFluidService-compatible surface ------------------------------
 

@@ -104,6 +104,9 @@ EVENTS: Dict[str, str] = {
     # expected tick by more than the stall threshold — a blocking call
     # (a readback regression, a synchronous compile) landed on the loop.
     "loop.stall": "asyncio serving-loop tick overshot the stall threshold",
+    # -- document residency (r19) -------------------------------------------
+    "doc.hibernate": "doc summarized, durable pointer updated, slot evicted",
+    "doc.wake": "COLD doc restored to a fleet slot on first op (latency_ms)",
 }
 
 

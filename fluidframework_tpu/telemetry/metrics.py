@@ -77,6 +77,11 @@ FAMILIES: Dict[str, str] = {
     "lumber_events_total": "counter",
     "lumber_duration_ms": "histogram",
     "store_requests_total": "counter",
+    # -- document residency (r19) -------------------------------------------
+    "residency_docs": "gauge",
+    "residency_wakes_total": "counter",
+    "residency_hit_ratio": "gauge",
+    "residency_wake_latency_ms": "histogram",
 }
 
 _LabelKey = Tuple[Tuple[str, str], ...]

@@ -136,6 +136,23 @@ SITES: Dict[str, str] = {
     # outage). Crash-after leaves the window armed — it self-disarms at
     # the window deadline, so the capture stays bounded either way.
     "profiler.arm": "fallback",
+    # Residency hibernate commit (DeviceFleetBackend._hibernate_commit —
+    # the r19 summarize→durable-pointer→evict walk for one idle doc): a
+    # failed or crashed-before hibernate did NOTHING — the document keeps
+    # its fleet slot, stays RESIDENT, and serves normally (the sweep may
+    # simply re-pick it later). A crash AFTER the commit left the doc
+    # durably COLD behind the LatestSummaryCache pointer — the first op
+    # wakes it through the normal miss path. Either way no op is lost
+    # and no document is stranded half-evicted.
+    "doc.hibernate": "fallback",
+    # Residency wake commit (DeviceFleetBackend._wake_commit — restoring
+    # a COLD document's slot on the first op that misses): a failed wake
+    # leaves the durable/cold state untouched and the triggering op
+    # PARKED (gapless, never dropped); the next op — or the quiescence
+    # flush — re-attempts the identical wake. A crash AFTER the restore
+    # is caught by the idempotence check (the slot is already live), so
+    # the retry lands as a counted noop, never a double-restore.
+    "doc.wake": "retry",
 }
 
 #: The recovery kinds the contract table documents. A site mapped to

@@ -412,6 +412,42 @@ def test_r15_exempt_from_profiler_keys(tmp_path):
     assert cba.check(str(tmp_path)) == 0
 
 
+_R16_COMPLETE = dict(
+    _R15_COMPLETE,
+    serving_host_tax_ms={"p50": 0.4, "p99": 1.2},
+    pump_lane_profile={"host_stage": 2.5, "loop_other": 0.7},
+    event_loop_lag_ms=0.8,
+)
+
+
+def test_r19_requires_residency_keys(tmp_path):
+    """An r19+ artifact must carry the residency pair — the cold-op wake
+    latency p99 AND the fleet-as-cache hit ratio (the fleet-as-cache
+    headline numbers must be driver-captured)."""
+    cba = _tool()
+    _write(tmp_path, "BENCH_r19.json", [json.dumps(_R16_COMPLETE)])
+    assert cba.check(str(tmp_path)) == 1
+    # One of the pair is not enough.
+    _write(tmp_path, "BENCH_r19.json", [json.dumps(dict(
+        _R16_COMPLETE, residency_wake_p99_ms=12.5,
+    ))])
+    assert cba.check(str(tmp_path)) == 1
+    _write(tmp_path, "BENCH_r19.json", [json.dumps(dict(
+        _R16_COMPLETE,
+        residency_wake_p99_ms=12.5,
+        residency_hit_ratio=0.92,
+    ))])
+    assert cba.check(str(tmp_path)) == 0
+
+
+def test_r18_exempt_from_residency_keys(tmp_path):
+    """Per-key since-round gating: an r18 artifact predates the
+    residency pair and passes with the twenty-one prior keys."""
+    cba = _tool()
+    _write(tmp_path, "BENCH_r18.json", [json.dumps(_R16_COMPLETE)])
+    assert cba.check(str(tmp_path)) == 0
+
+
 def test_newest_round_governs(tmp_path):
     cba = _tool()
     _write(tmp_path, "BENCH_r05.json", ['{"metric": "old"}'])

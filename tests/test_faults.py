@@ -549,6 +549,191 @@ class TestPumpChaos:
 
 
 # ---------------------------------------------------------------------------
+# Residency chaos: the r19 doc.hibernate / doc.wake recovery matrix
+# (docs/failure-semantics.md §"Residency lifecycle") — fail / crash-before /
+# crash-after at both commit boundaries, bit-identical post-recovery state.
+
+
+class TestResidencyChaos:
+    def _reference(self, rounds: int) -> DeviceFleetBackend:
+        ref = _make_backend()
+        for r in range(rounds):
+            _feed_backend(ref, r)
+            ref.pump_stage()
+        ref.pump_drain()
+        return ref
+
+    def _resident(self, rounds: int = 1) -> DeviceFleetBackend:
+        be = _make_backend()
+        for r in range(rounds):
+            _feed_backend(be, r)
+            be.pump_stage()
+        be.pump_drain()
+        return be
+
+    def test_hibernate_fail_stays_resident_retry_succeeds(self):
+        """``doc.hibernate`` fail → fallback: the doc stays RESIDENT
+        with its slot live (counted, never silent), and a clean retry
+        hibernates it for real."""
+        from fluidframework_tpu.service import residency
+
+        be = self._resident()
+        idx = be._index[("d0", "s")]
+        pre = _recovery_total("doc.hibernate", "fallback")
+        faults.arm("doc.hibernate", faults.FailN(1))
+        assert be.hibernate_doc("d0") is False
+        faults.disarm()
+        assert _recovery_total("doc.hibernate", "fallback") == pre + 1
+        assert be.residency.state("d0") == residency.RESIDENT
+        assert be.fleet.placement[idx] is not None, "slot must stay live"
+        assert be.hibernate_doc("d0") is True  # clean retry
+        assert be.residency.state("d0") == residency.COLD
+        assert be.fleet.placement[idx] is None
+        _feed_backend(be, 1)  # first op wakes it back
+        be.pump_stage()
+        be.pump_drain()
+        stats = be.stats()
+        assert stats["ops_applied"] == 2 * N_CH * K
+        assert stats["docs_with_errors"] == 0
+        _pool_parity(be, self._reference(2))
+
+    def test_hibernate_crash_before_stays_resident(self):
+        """Crash BEFORE the eviction commit: nothing happened — the doc
+        is RESIDENT, the slot live, and the next round serves it as if
+        the sweep never ran."""
+        from fluidframework_tpu.service import residency
+
+        be = self._resident()
+        idx = be._index[("d0", "s")]
+        faults.arm("doc.hibernate", faults.CrashAt("before"))
+        with pytest.raises(faults.InjectedCrash):
+            be.hibernate_doc("d0")
+        faults.disarm()
+        assert be.residency.state("d0") == residency.RESIDENT
+        assert be.fleet.placement[idx] is not None
+        _feed_backend(be, 1)
+        be.pump_stage()
+        be.pump_drain()
+        assert be.stats()["ops_applied"] == 2 * N_CH * K
+        _pool_parity(be, self._reference(2))
+
+    def test_hibernate_crash_after_is_durably_cold_wake_serves(self):
+        """Crash AFTER the eviction commit: the slots are freed and the
+        cold records landed — the manager records the doc COLD (the
+        at-least-once window resolved toward reality), and the first op
+        wakes it through the normal path with bit-identical state."""
+        from fluidframework_tpu.service import residency
+
+        be = self._resident()
+        idx = be._index[("d0", "s")]
+        faults.arm("doc.hibernate", faults.CrashAt("after"))
+        with pytest.raises(faults.InjectedCrash):
+            be.hibernate_doc("d0")
+        faults.disarm()
+        assert be.residency.state("d0") == residency.COLD
+        assert be.fleet.placement[idx] is None, "eviction was durable"
+        _feed_backend(be, 1)
+        be.pump_stage()
+        be.pump_drain()
+        stats = be.stats()
+        assert stats["ops_applied"] == 2 * N_CH * K
+        assert stats["docs_with_errors"] == 0
+        assert be.residency.stats()["wakes"].get("ok", 0) == 1
+        _pool_parity(be, self._reference(2))
+
+    def test_wake_fail_parks_rows_flush_retries(self):
+        """``doc.wake`` fail → retry: the durable/cold state is
+        untouched and the triggering rows PARK (bounded queue — counted
+        into pressure, never dropped); the quiescence flush re-attempts
+        the wake and every parked row applies in order."""
+        from fluidframework_tpu.service import residency
+
+        be = self._resident()
+        assert be.hibernate_doc("d0") is True
+        pre = _recovery_total("doc.wake", "retry")
+        faults.arm("doc.wake", faults.FailN(1))
+        _feed_backend(be, 1)  # d0's frame parks; the rest buffer
+        faults.disarm()
+        assert _recovery_total("doc.wake", "retry") == pre + 1
+        assert be.residency.state("d0") == residency.WAKING
+        assert be.stats()["parked_rows"] == K
+        assert be.needs_flush(), "parked rows must demand a flush"
+        be.flush()  # the quiescence backstop retries the wake
+        be.pump_drain()
+        stats = be.stats()
+        assert stats["ops_applied"] == 2 * N_CH * K
+        assert stats["parked_rows"] == 0
+        assert stats["docs_with_errors"] == 0
+        assert be.residency.state("d0") == residency.RESIDENT
+        _pool_parity(be, self._reference(2))
+
+    def test_wake_crash_before_parks_rows_flush_recovers(self):
+        """Crash BEFORE the restore: cold state untouched, rows parked;
+        the disarmed flush retries the wake from the unchanged durable
+        state — no op lost, none duplicated."""
+        from fluidframework_tpu.service import residency
+
+        be = self._resident()
+        assert be.hibernate_doc("d0") is True
+        faults.arm("doc.wake", faults.CrashAt("before"))
+        ar = np.arange(K, dtype=np.int32)
+        rows = np.zeros((K, OP_WIDTH), np.int32)
+        rows[:, F_TYPE] = OP_INSERT
+        rows[:, F_LEN] = 1
+        rows[:, F_SEQ] = K + 1 + ar
+        rows[:, F_REF] = K
+        rows[:, F_ARG] = K + 1 + ar
+        with pytest.raises(faults.InjectedCrash):
+            be.enqueue_frame("d0", SeqFrame("s", 0, 1, rows, (), 0.0))
+        faults.disarm()
+        assert be.residency.state("d0") == residency.WAKING
+        assert be.stats()["parked_rows"] == K
+        for i in range(1, N_CH):  # the rest of the round feeds normally
+            r2 = rows.copy()
+            be.enqueue_frame(f"d{i}", SeqFrame("s", 0, 1, r2, (), 0.0))
+        be.flush()
+        be.pump_drain()
+        stats = be.stats()
+        assert stats["ops_applied"] == 2 * N_CH * K
+        assert stats["parked_rows"] == 0
+        assert be.residency.state("d0") == residency.RESIDENT
+        _pool_parity(be, self._reference(2))
+
+    def test_wake_crash_after_restore_is_idempotent(self):
+        """Crash AFTER the restore: the slot is live and the rows
+        unparked — the wake finishes as completed before the crash
+        propagates, and the retry path (had one raced in) would find no
+        cold record and count ``noop`` instead of double-restoring."""
+        from fluidframework_tpu.service import residency
+
+        be = self._resident()
+        assert be.hibernate_doc("d0") is True
+        idx = be._index[("d0", "s")]
+        faults.arm("doc.wake", faults.CrashAt("after"))
+        ar = np.arange(K, dtype=np.int32)
+        rows = np.zeros((K, OP_WIDTH), np.int32)
+        rows[:, F_TYPE] = OP_INSERT
+        rows[:, F_LEN] = 1
+        rows[:, F_SEQ] = K + 1 + ar
+        rows[:, F_REF] = K
+        rows[:, F_ARG] = K + 1 + ar
+        with pytest.raises(faults.InjectedCrash):
+            be.enqueue_frame("d0", SeqFrame("s", 0, 1, rows, (), 0.0))
+        faults.disarm()
+        assert be.residency.state("d0") == residency.RESIDENT
+        assert be.fleet.placement[idx] is not None
+        assert be.stats()["parked_rows"] == 0, "completed wake unparked"
+        assert ("d0", "s") not in be._cold
+        for i in range(1, N_CH):
+            r2 = rows.copy()
+            be.enqueue_frame(f"d{i}", SeqFrame("s", 0, 1, r2, (), 0.0))
+        be.flush()
+        be.pump_drain()
+        assert be.stats()["ops_applied"] == 2 * N_CH * K
+        _pool_parity(be, self._reference(2))
+
+
+# ---------------------------------------------------------------------------
 # Websocket delivery: requeue recovery over real sockets
 
 

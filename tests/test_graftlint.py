@@ -1598,3 +1598,53 @@ def test_host_sync_flags_profiler_producer_bare_transfer(tmp_path):
     )
     assert len(findings) == 1
     assert "device→host" in findings[0].message
+
+
+# -- r19 residency fixtures ----------------------------------------------------
+
+
+def test_fault_site_accepts_residency_sites(tmp_path):
+    """The r19 residency commit boundaries — ``doc.hibernate`` (the
+    summarize→pointer walk already ran; this evicts the slots) and
+    ``doc.wake`` (restore the cold states and unpark pending ops) —
+    are documented vocabulary: production boundaries decorated with
+    them pass lint."""
+    findings = _run_pass(
+        _fault_site_pass(),
+        """
+        from fluidframework_tpu.testing.faults import inject_fault
+
+        @inject_fault("doc.hibernate")
+        def hibernate_commit(backend, doc_id, idxs, states):
+            return backend.fleet.evict_docs(idxs, states)
+
+        @inject_fault("doc.wake")
+        def wake_commit(backend, doc_id):
+            for key, (state, head) in backend.cold_records(doc_id):
+                backend.fleet.restore_doc(key, state)
+        """,
+        tmp_path,
+    )
+    assert findings == []
+
+
+def test_fault_site_flags_unregistered_residency_site(tmp_path):
+    """The r19 regression shape: a residency boundary added to a
+    production module without declaring it in the vocabulary (e.g. a
+    ``doc.freeze`` eviction variant) must fail lint — the
+    stay-resident/retry contracts only exist if the site is
+    documented."""
+    findings = _run_pass(
+        _fault_site_pass(),
+        """
+        from fluidframework_tpu.testing.faults import inject_fault
+
+        @inject_fault("doc.freeze")
+        def freeze(backend, doc_id):
+            return backend.hibernate_doc(doc_id)
+        """,
+        tmp_path,
+    )
+    assert len(findings) == 1
+    assert "unknown injection site" in findings[0].message
+    assert "doc.freeze" in findings[0].message

@@ -1,0 +1,609 @@
+"""Fleet-as-cache residency (r19): the shared heat signal, hibernation,
+and bounded-latency wake.
+
+Pinned here:
+
+- ``HeatTracker``'s window-normalized rate — the cold-start-bias fix,
+  regression-tested for BOTH consumers (the multi-node rebalancer's doc
+  selection and the residency manager's hibernation ordering).
+- Hibernate→wake bit parity against a never-evicted run on the dense
+  fleet, the 8-device mesh, and the multi-pool (promotion/demotion)
+  layout — plus the tier-demotion walk riding the existing scan.
+- A move-bearing SharedTree document surviving the hibernate→wake cycle
+  through the full pipeline (tree truth rides the durable log; the
+  doc's device channels evict and restore bit-identically).
+- Wake under concurrent submit over a REAL websocket: a faulted wake
+  parks the burst in the bounded pending queue and the retry admits it
+  gapless and in order — never dropped, never reordered.
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fluidframework_tpu.parallel.mesh import make_mesh
+from fluidframework_tpu.protocol.constants import (
+    F_ARG,
+    F_LEN,
+    F_MSN,
+    F_POS1,
+    F_POS2,
+    F_REF,
+    F_SEQ,
+    F_TYPE,
+    OP_INSERT,
+    OP_REMOVE,
+    OP_WIDTH,
+)
+from fluidframework_tpu.protocol.opframe import SeqFrame
+from fluidframework_tpu.service import residency
+from fluidframework_tpu.service.device_backend import DeviceFleetBackend
+from fluidframework_tpu.service.residency import HeatTracker, ResidencyManager
+from fluidframework_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# HeatTracker: the shared decayed-rate signal and the cold-start-bias fix
+
+
+class TestHeatTracker:
+    def test_steady_rate_is_age_invariant(self):
+        """A document producing r ops per window scores rate == r at ANY
+        age — the property raw accumulators lack (they sweep from r up
+        to r/(1-decay) as the doc ages)."""
+        h = HeatTracker(decay=0.5)
+        for _ in range(12):
+            h.touch("d", 3.0)
+            assert abs(h.rate("d") - 3.0) < 1e-9
+            h.observe_window()
+
+    def test_cold_start_bias_raw_misranks_rate_fixes(self):
+        """The regression the extraction fixes: an aged doc at a steady
+        3 ops/window accumulates ~6 raw, out-ranking a brand-new doc
+        doing 5 ops/window at raw 5 — the normalized rate ranks them
+        correctly."""
+        h = HeatTracker(decay=0.5)
+        for _ in range(10):
+            h.touch("aged", 3.0)
+            h.observe_window()
+        h.touch("aged", 3.0)
+        h.touch("young", 5.0)
+        assert h.raw("aged") > h.raw("young"), "the bias this test pins"
+        assert h.rate("young") > h.rate("aged"), "rate() must fix it"
+        assert abs(h.rate("aged") - 3.0) < 1e-6
+        assert abs(h.rate("young") - 5.0) < 1e-6
+
+    def test_prune_bounds_the_tracker(self):
+        """At a million-document corpus the tracker must not retain
+        every id ever touched: entries decay out below the prune floor,
+        and a pruned doc that returns is simply new."""
+        h = HeatTracker(decay=0.5)
+        for i in range(1000):
+            h.touch(f"d{i}")
+        assert len(h) == 1000
+        for _ in range(20):
+            h.observe_window()
+        assert len(h) == 0
+        h.touch("d0")
+        assert h.export("d0") == (1.0, 0)  # windows restarted
+
+    def test_export_adopt_roundtrip_preserves_rate(self):
+        a = HeatTracker(decay=0.5)
+        for _ in range(6):
+            a.touch("d", 4.0)
+            a.observe_window()
+        a.touch("d", 4.0)
+        b = HeatTracker(decay=0.5)
+        b.adopt("d", *a.export("d"))
+        assert b.rate("d") == a.rate("d")
+        a.forget("d")
+        assert a.rate("d") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Consumer 1: the multi-node rebalancer — normalized doc selection and
+# the migration heat hand-off
+
+
+class TestRebalanceHeat:
+    def _cluster(self, n=2):
+        from fluidframework_tpu.service.multinode import NodeCluster
+
+        t = [0.0]
+        return NodeCluster(n_nodes=n, clock=lambda: t[0])
+
+    def test_rebalance_migrates_young_hot_doc_not_aged_lukewarm(self):
+        """The cold-start-bias regression at the rebalancer: node 0 owns
+        an aged 3-ops/window doc (raw ~6) and a brand-new 5-ops/window
+        doc (raw 5). The pre-r19 raw key would migrate the AGED doc; the
+        normalized rate migrates the genuinely hotter young one."""
+        c = self._cluster()
+        n0 = c.nodes[0]
+        assert n0.try_own("aged") and n0.try_own("young")
+        for _ in range(10):
+            n0.heat.touch("aged", 3.0)
+            n0.heat.observe_window()
+        n0.heat.touch("aged", 3.0)
+        n0.heat.touch("young", 5.0)
+        # The compatibility view still shows the raw accumulators —
+        # and the bias the raw key suffered:
+        assert n0.op_rate["aged"] > n0.op_rate["young"]
+        moves = c.rebalance()
+        assert [m[0] for m in moves] == [("young")], (
+            "rebalance must select by normalized rate, not raw decay mass"
+        )
+        assert moves[0][1:] == ("node-0", "node-1")
+
+    def test_migration_hands_heat_to_new_owner(self):
+        """A migrated doc must not restart cold-start normalization on
+        the destination: its (raw, windows) ride the move, then age with
+        the pass's decay like everything else."""
+        c = self._cluster()
+        n0, n1 = c.nodes
+        assert n0.try_own("aged") and n0.try_own("young")
+        for _ in range(10):
+            n0.heat.touch("aged", 3.0)
+            n0.heat.observe_window()
+        n0.heat.touch("aged", 3.0)
+        n0.heat.touch("young", 5.0)
+        c.rebalance()
+        # Exported at (5.0, windows=0), adopted, then one aging window:
+        assert n1.heat.export("young") == (2.5, 1)
+        assert n0.heat.raw("young") == 0.0, "old owner forgot the doc"
+        assert "young" not in n0.op_rate
+
+    def test_op_rate_view_and_lifecycle_compat(self):
+        """The pre-r19 ``op_rate`` dict shape survives as a read-only
+        view: ``.get`` on unknown docs, emptied by kill()."""
+        c = self._cluster()
+        n0 = c.nodes[0]
+        assert n0.try_own("d")
+        n0.heat.touch("d", 2.0)
+        assert n0.op_rate.get("d") == 2.0
+        assert n0.op_rate.get("nope") is None
+        n0.kill()
+        assert n0.op_rate == {}
+
+
+# ---------------------------------------------------------------------------
+# Consumer 2: the residency manager — same signal, same normalization
+
+
+class TestResidencySharedSignal:
+    def test_hibernation_candidates_order_by_normalized_rate(self):
+        """Candidates come back coldest-first by the SAME rate() both
+        consumers share — an aged lukewarm doc hibernates before a
+        young hot one even though its raw accumulator is larger."""
+        rm = ResidencyManager(heat=HeatTracker(decay=0.5), heat_floor=10.0)
+        rm.note_admit("aged")
+        rm.note_admit("young")
+        for _ in range(10):
+            rm.heat.touch("aged", 3.0)
+            rm.heat.observe_window()
+        rm.heat.touch("aged", 3.0)
+        rm.heat.touch("young", 5.0)
+        assert rm.heat.raw("aged") > rm.heat.raw("young")
+        rm.mark_idle("aged")
+        rm.mark_idle("young")
+        assert rm.hibernation_candidates(want=2) == ["aged", "young"]
+
+    def test_heat_floor_guards_hot_docs(self):
+        """Without capacity pressure, a doc above the heat floor never
+        hibernates no matter how long it sits clientless."""
+        rm = ResidencyManager(heat=HeatTracker(), heat_floor=0.5)
+        rm.note_admit("hot")
+        rm.heat.touch("hot", 50.0)
+        rm.mark_idle("hot")
+        assert rm.hibernation_candidates(want=8) == []
+
+    def test_hit_ratio_accounting(self):
+        rm = ResidencyManager(heat=HeatTracker())
+        rm.note_admit("d")
+        for _ in range(3):
+            assert rm.note_op("d")
+        rm.begin_hibernate("d")
+        rm.finish_hibernate("d", ok=True)
+        assert rm.note_op("d") is False  # the miss that triggers a wake
+        assert rm.hit_ratio() == 0.75
+
+
+# ---------------------------------------------------------------------------
+# Hibernate -> wake bit parity against a never-evicted run
+
+
+def _feed(be, n_ch, k, r):
+    ar = np.arange(k, dtype=np.int32)
+    for i in range(n_ch):
+        rows = np.zeros((k, OP_WIDTH), np.int32)
+        rows[:, F_TYPE] = OP_INSERT
+        rows[:, F_LEN] = 1
+        rows[:, F_SEQ] = r * k + 1 + ar
+        rows[:, F_REF] = r * k
+        rows[:, F_ARG] = r * k + 1 + ar
+        texts = tuple(chr(97 + (r * k + j) % 26) for j in range(k))
+        be.enqueue_frame(f"d{i}", SeqFrame("s", 0, 1, rows, texts, 0.0))
+
+
+def _assert_state_parity(a: DeviceFleetBackend, b: DeviceFleetBackend):
+    assert sorted(a.fleet.pools) == sorted(b.fleet.pools)
+    for cap, pool_a in a.fleet.pools.items():
+        pool_b = b.fleet.pools[cap]
+        for name, x, y in zip(
+            pool_a.state._fields, pool_a.state, pool_b.state
+        ):
+            assert bool(jnp.array_equal(x, y)), (cap, name)
+
+
+def _run(be, n_ch, k, rounds, hibernate_at=None, doc="d0"):
+    """Feed ``rounds`` rounds; after round ``hibernate_at`` evict ``doc``
+    (the next round's first op wakes it)."""
+    woke = False
+    for r in range(rounds):
+        _feed(be, n_ch, k, r)
+        be.flush()
+        if hibernate_at is not None and r == hibernate_at:
+            assert be.hibernate_doc(doc) is True
+            assert be.residency.state(doc) == residency.COLD
+            assert be.fleet.placement[be._index[(doc, "s")]] is None
+            woke = True
+    be.collect_now()
+    if woke:
+        assert be.residency.stats()["wakes"].get("ok", 0) >= 1
+
+
+class TestWakeParity:
+    def test_dense(self):
+        """Hibernate d0 mid-stream, wake it on the next round's first op:
+        pool states, served text, and totals are bit-identical to the
+        run that never evicted."""
+        n_ch, k, rounds = 6, 4, 5
+        hib = DeviceFleetBackend(capacity=64, pump_mode=True)
+        ref = DeviceFleetBackend(capacity=64, pump_mode=True)
+        _run(hib, n_ch, k, rounds, hibernate_at=2)
+        _run(ref, n_ch, k, rounds)
+        assert hib.ops_applied == ref.ops_applied == n_ch * k * rounds
+        _assert_state_parity(hib, ref)
+        assert hib.text("d0", "s") == ref.text("d0", "s")
+        assert hib.stats()["docs_with_errors"] == 0
+        assert hib.stats()["hibernations"] == 1
+
+    def test_mesh(self):
+        """Same pin on the 8-device virtual mesh: eviction and restore
+        round-trip the sharded pool layout bit-identically."""
+        mesh = make_mesh()
+        n_ch, k, rounds = 16, 4, 4
+        hib = DeviceFleetBackend(capacity=64, mesh=mesh, pump_mode=True)
+        ref = DeviceFleetBackend(capacity=64, mesh=mesh, pump_mode=True)
+        _run(hib, n_ch, k, rounds, hibernate_at=1, doc="d3")
+        _run(ref, n_ch, k, rounds)
+        assert hib.ops_applied == ref.ops_applied == n_ch * k * rounds
+        _assert_state_parity(hib, ref)
+        assert hib.text("d3", "s") == ref.text("d3", "s")
+
+    def test_multi_pool_promoted_doc(self):
+        """A doc promoted past its base tier hibernates out of the BIG
+        pool and wakes back into it — the cold record carries the
+        promoted-capacity state, and parity holds lane for lane."""
+        n_ch, k, rounds = 2, 8, 8
+        hib = DeviceFleetBackend(
+            capacity=16, max_capacity=256, pump_mode=True
+        )
+        ref = DeviceFleetBackend(
+            capacity=16, max_capacity=256, pump_mode=True
+        )
+        _run(hib, n_ch, k, rounds, hibernate_at=5)
+        _run(ref, n_ch, k, rounds)
+        assert hib.fleet.migrations > 0, "the stream must really promote"
+        cap, _slot = hib.fleet.placement[hib._index[("d0", "s")]]
+        assert cap > 16, "d0 must wake back into the promoted tier"
+        assert hib.ops_applied == ref.ops_applied == n_ch * k * rounds
+        _assert_state_parity(hib, ref)
+        assert hib.text("d0", "s") == ref.text("d0", "s")
+
+    def test_demotion_rides_the_scan_then_wake_parity(self):
+        """The capacity-tier demotion walk (the inverse of promotion,
+        riding the SAME one-boxcar-stale scan): a promoted doc whose
+        live rows fall below the low-water mark after the collab window
+        passes its removes steps back down a tier — and a hibernate→wake
+        cycle after the demotion still restores bit-identical state."""
+
+        def build():
+            be = DeviceFleetBackend(
+                capacity=16, max_capacity=256, pump_mode=True,
+                compact_every=2,
+            )
+            k = 8
+            for r in range(3):  # promote d0 past the base tier
+                _feed(be, 1, k, r)
+                be.flush()
+            rm = np.zeros((1, OP_WIDTH), np.int32)
+            rm[0, F_TYPE] = OP_REMOVE
+            rm[0, F_POS1], rm[0, F_POS2] = 0, 22
+            rm[0, F_SEQ], rm[0, F_REF], rm[0, F_MSN] = 25, 24, 25
+            be.enqueue_frame("d0", SeqFrame("s", 0, 1, rm, (), 0.0))
+            be.flush()
+            for j in range(6):  # window past the remove; keep scans coming
+                one = np.zeros((1, OP_WIDTH), np.int32)
+                one[0, F_TYPE] = OP_INSERT
+                one[0, F_LEN] = 1
+                one[0, F_SEQ] = 26 + j
+                one[0, F_REF] = 25 + j
+                one[0, F_ARG] = 26 + j
+                one[0, F_MSN] = 26 + j
+                be.enqueue_frame(
+                    "d0", SeqFrame("s", 0, 1, one, ("z",), 0.0)
+                )
+                be.flush()
+            be.collect_now()
+            return be
+
+        hib = build()
+        ref = build()
+        assert hib.fleet.stats()["demotions"] > 0
+        idx = hib._index[("d0", "s")]
+        cap, _slot = hib.fleet.placement[idx]
+        assert cap == 16, "d0 must have stepped back down to the base tier"
+        # Now the hibernate→wake cycle on the demoted doc:
+        assert hib.hibernate_doc("d0") is True
+        one = np.zeros((1, OP_WIDTH), np.int32)
+        one[0, F_TYPE] = OP_INSERT
+        one[0, F_LEN] = 1
+        one[0, F_SEQ], one[0, F_REF], one[0, F_ARG] = 32, 31, 32
+        one[0, F_MSN] = 32
+        for be in (hib, ref):
+            be.enqueue_frame("d0", SeqFrame("s", 0, 1, one, ("!",), 0.0))
+            be.flush()
+            be.collect_now()
+        assert hib.residency.state("d0") == residency.RESIDENT
+        _assert_state_parity(hib, ref)
+        assert hib.text("d0", "s") == ref.text("d0", "s")
+        assert hib.stats()["docs_with_errors"] == 0
+
+
+# ---------------------------------------------------------------------------
+# The full pipeline: a move-bearing SharedTree doc through hibernate->wake
+
+
+def _drain(rts):
+    for rt in rts:
+        rt.flush()
+    while any(rt.process_incoming() for rt in rts):
+        pass
+
+
+def _force_hibernate(svc, doc_id, sweeps=12):
+    """Run sweeps until the doc's heat decays under the floor and the
+    sweep takes it (each sweep closes one decay window)."""
+    for _ in range(sweeps):
+        if doc_id in svc.hibernate_sweep():
+            return True
+    return False
+
+
+class TestPipelineHibernation:
+    def test_move_bearing_shared_tree_doc_survives_hibernate_wake(self):
+        """A doc carrying a SharedTree (with first-class moves) AND a
+        device-backed string channel hibernates once idle and wakes on
+        the next op. Parity against a never-hibernated service run of
+        the identical edit script: same tree view (moves included), same
+        device channel state, and a fresh catch-up client converges."""
+        from fluidframework_tpu.models.shared_string import SharedString
+        from fluidframework_tpu.runtime.container import ContainerRuntime
+        from fluidframework_tpu.service.pipeline import PipelineFluidService
+        from fluidframework_tpu.tree.shared_tree import SharedTree
+
+        def script(svc, hibernate):
+            a = ContainerRuntime(
+                svc, "doc",
+                channels=(SharedTree("t"), SharedString("s")),
+            )
+            ta, sa = a.get_channel("t"), a.get_channel("s")
+            sa.insert_text(0, "tree doc")
+            for i in range(6):
+                ta.insert_nodes(len(ta.get()), [f"n{i}"])
+                _drain([a])
+            ta.move_nodes(0, 2, 4)  # the first-class move
+            _drain([a])
+            stash = a.get_pending_local_state()
+            a.disconnect()
+            svc.pump()
+            if hibernate:
+                assert svc.doc_is_idle("doc")
+                assert _force_hibernate(svc, "doc"), "sweep must take it"
+                assert svc.device.residency.state("doc") == residency.COLD
+                # A durable pointer landed for the wake-independent path:
+                assert svc.read_tier.latest.latest_handle("doc") is not None
+            # The user reopens the stashed session: their first edit is
+            # the first op the doc has seen — on the hibernated service
+            # it wakes the doc through the pending queue.
+            b = ContainerRuntime.rehydrate(
+                svc, "doc", stash,
+                channels=(SharedTree("t"), SharedString("s")),
+            )
+            b.process_incoming()
+            tb, sb = b.get_channel("t"), b.get_channel("s")
+            tb.insert_nodes(0, ["woke"])
+            sb.insert_text(0, "! ")
+            _drain([b])
+            return b, tb.get(), svc.device_text("doc", "s")
+
+        svc_h = PipelineFluidService(n_partitions=2)
+        svc_r = PipelineFluidService(n_partitions=2)
+        _b_h, tree_h, text_h = script(svc_h, hibernate=True)
+        _b_r, tree_r, text_r = script(svc_r, hibernate=False)
+        assert tree_h == tree_r
+        assert tree_h == ["woke", "n2", "n3", "n4", "n5", "n0", "n1"], (
+            "the pre-hibernation moves must survive the wake"
+        )
+        assert text_h == text_r == "! tree doc"
+        assert svc_h.device.residency.state("doc") == residency.RESIDENT
+        assert svc_h.device.residency.stats()["wakes"].get("ok", 0) >= 1
+        # Device channel state parity, key for key (slot layout may
+        # differ between independent services; the doc state may not):
+        keys = [k for k in svc_h.device.channels() if k[0] == "doc"]
+        st_h = svc_h.device.doc_states(keys)
+        st_r = svc_r.device.doc_states(keys)
+        for key in keys:
+            for name, x, y in zip(
+                st_h[key]._fields, st_h[key], st_r[key]
+            ):
+                assert bool(jnp.array_equal(x, y)), (key, name)
+
+    def test_cold_doc_serves_reads_without_waking(self):
+        """Snapshot reads of a COLD doc serve from the cold record —
+        the read tier never burns a fleet slot on a doc nobody is
+        writing to."""
+        from fluidframework_tpu.models.shared_string import SharedString
+        from fluidframework_tpu.runtime.container import ContainerRuntime
+        from fluidframework_tpu.service.pipeline import PipelineFluidService
+
+        svc = PipelineFluidService(n_partitions=2)
+        a = ContainerRuntime(svc, "doc", channels=(SharedString("s"),))
+        a.get_channel("s").insert_text(0, "cold read")
+        _drain([a])
+        a.disconnect()
+        svc.pump()
+        assert _force_hibernate(svc, "doc")
+        assert svc.device_text("doc", "s") == "cold read"
+        assert svc.device.residency.state("doc") == residency.COLD, (
+            "a read must not wake the doc"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Wake under concurrent submit over a REAL websocket: the bounded pending
+# queue admits the burst gapless and in order
+
+
+class TestWakeOverWebsocket:
+    def test_wake_under_concurrent_submit_pins_pending_order(self):
+        from fluidframework_tpu.drivers.network_driver import (
+            NetworkFluidService,
+        )
+        from fluidframework_tpu.models.shared_string import SharedString
+        from fluidframework_tpu.protocol.types import MessageType
+        from fluidframework_tpu.runtime.container import ContainerRuntime
+        from fluidframework_tpu.service.network_server import (
+            FluidNetworkServer,
+        )
+        from fluidframework_tpu.service.pipeline import PipelineFluidService
+
+        svc = PipelineFluidService(n_partitions=2)
+        srv = FluidNetworkServer(service=svc, residency_sweep_s=0.01)
+        srv.start()
+        try:
+            def drain_net(rts, timeout=10.0):
+                for rt in rts:
+                    rt.flush()
+                deadline = time.monotonic() + timeout
+                quiet = 0
+                while time.monotonic() < deadline and quiet < 3:
+                    if any(rt.process_incoming() for rt in rts):
+                        quiet = 0
+                    else:
+                        quiet += 1
+                        time.sleep(0.02)
+
+            # Seed the doc, then go idle: the server's ticker sweep
+            # hibernates it off the serving loop.
+            net_a = NetworkFluidService("127.0.0.1", srv.port)
+            a = ContainerRuntime(
+                net_a, "wakedoc", channels=(SharedString("s"),)
+            )
+            a.get_channel("s").insert_text(0, "hello ")
+            drain_net([a])
+            a.disconnect()
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                if svc.device.residency.state("wakedoc") == residency.COLD:
+                    break
+                time.sleep(0.02)
+            assert svc.device.residency.state("wakedoc") == residency.COLD
+            assert srv.residency_sweeps > 0
+
+            # A bystander doc with a LIVE client — it must keep serving
+            # while the wake is in flight (and never hibernate).
+            net_c = NetworkFluidService("127.0.0.1", srv.port)
+            c = ContainerRuntime(
+                net_c, "busydoc", channels=(SharedString("s"),)
+            )
+            c.get_channel("s").insert_text(0, "busy")
+            drain_net([c])
+
+            # Fault the FIRST wake attempt: the burst's head op parks,
+            # the following ops park behind it in arrival order, and the
+            # retry (the next park / the quiescence flush) admits the
+            # whole queue as a normal gapless boxcar.
+            faults.arm("doc.wake", faults.FailN(1))
+            net_b = NetworkFluidService("127.0.0.1", srv.port)
+            b = ContainerRuntime(
+                net_b, "wakedoc", channels=(SharedString("s"),)
+            )
+            b.process_incoming()
+            sb = b.get_channel("s")
+            for i in range(4):  # the concurrent-submit burst
+                sb.insert_text(len(sb.get_text()), f"w{i}")
+                b.flush()
+            c.get_channel("s").insert_text(4, "!")  # concurrent traffic
+            drain_net([b, c])
+            faults.disarm()
+            drain_net([b, c])
+
+            assert sb.get_text() == "hello w0w1w2w3"
+            assert c.get_channel("s").get_text() == "busy!"
+            # Server-side device replica converged identically — nothing
+            # in the parked burst was lost, duplicated, or reordered:
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                # This poll crosses threads against the live server loop
+                # (the residency ticker is still sweeping): a donated
+                # pool state can transiently vanish mid-readback. Retry
+                # inside the deadline; the asserts below are the real
+                # check.
+                try:
+                    if svc.device.stats()["parked_rows"] == 0 and (
+                        svc.device_text("wakedoc", "s")
+                        == "hello w0w1w2w3"
+                    ):
+                        break
+                except RuntimeError:
+                    pass
+                time.sleep(0.05)
+            assert svc.device_text("wakedoc", "s") == "hello w0w1w2w3"
+            assert svc.device.stats()["parked_rows"] == 0
+            rs = svc.device.residency.stats()
+            assert rs["wakes"].get("retry", 0) >= 1, "the faulted attempt"
+            assert rs["wakes"].get("ok", 0) >= 1, "the recovery"
+            # The sequenced stream itself is gapless and strictly
+            # ordered — the pending queue preserved the total order:
+            seqs = [
+                m.sequence_number
+                for m in svc.get_deltas("wakedoc", from_seq=0)
+            ]
+            assert seqs == sorted(seqs)
+            assert len(seqs) == len(set(seqs)), "no duplicated tickets"
+            ops = [
+                m
+                for m in svc.get_deltas("wakedoc", from_seq=0)
+                if m.type == MessageType.OPERATION
+            ]
+            texts = [
+                m.contents.get("contents", {}).get("text")
+                for m in ops
+                if isinstance(m.contents, dict)
+            ]
+            want = ["hello ", "w0", "w1", "w2", "w3"]
+            got = [t for t in texts if t in want]
+            assert got == want, "burst must sequence in submit order"
+        finally:
+            faults.disarm()
+            srv.stop()

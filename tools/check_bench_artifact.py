@@ -49,7 +49,11 @@ from typing import List, Tuple
 # captured window, the one-dispatch fusion item's justification
 # number), the per-lane pump decomposition (coverage ≥ 0.95 and the
 # device-idle reconciliation against serving_pump_device_idle_frac
-# asserted in-bench), and the loop-stall watchdog's lag gauge — in r16.
+# asserted in-bench), and the loop-stall watchdog's lag gauge — in r16;
+# the residency pair — the cold-op wake latency p99 (first parked op to
+# slot restored, measured over the million-doc-corpus churn lane,
+# parity-pinned against a never-evicted run with zero lost/dup asserted
+# in-bench) and the fleet-as-cache hit ratio — in r19.
 REQUIRED = (
     ("pipeline_serving_ops_per_sec", 6),
     ("deli_scribe_e2e_ops_per_sec", 6),
@@ -72,6 +76,8 @@ REQUIRED = (
     ("serving_host_tax_ms", 16),
     ("pump_lane_profile", 16),
     ("event_loop_lag_ms", 16),
+    ("residency_wake_p99_ms", 19),
+    ("residency_hit_ratio", 19),
 )
 # Artifacts up to round 5 predate every gated metric.
 BASELINE_ROUND = 5
